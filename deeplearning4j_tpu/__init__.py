@@ -1,0 +1,21 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capability surface of
+deeplearning4j (reference: arshadm/deeplearning4j @ 0.4-rc3.9): layer zoo,
+fluent config DSL with JSON round-trip, Sequential (MultiLayerNetwork) and
+Graph (ComputationGraph) facades, updater zoo, evaluation, early stopping,
+checkpointing, data-parallel training over TPU meshes, NLP embeddings,
+graph embeddings, clustering, and training observability.
+
+Design (see SURVEY.md §7): a pure-functional core — layers are
+``init``/``apply`` pairs over parameter pytrees, the train step is one jitted
+pure function — wrapped by thin stateful facades that reproduce the
+reference's API surface. Scale-out is in-graph XLA collectives over a
+``jax.sharding.Mesh`` (ICI/DCN), not driver-centric parameter shipping.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork

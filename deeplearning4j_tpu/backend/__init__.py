@@ -1,0 +1,8 @@
+from deeplearning4j_tpu.backend.device import (
+    default_mesh,
+    device_count,
+    local_devices,
+    dtype_policy,
+    DTypePolicy,
+)
+from deeplearning4j_tpu.backend.rng import KeyStream

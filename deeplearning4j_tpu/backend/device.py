@@ -1,0 +1,118 @@
+"""Device/mesh substrate — the TPU-native equivalent of ND4J + AffinityManager.
+
+The reference pins replicas to devices through ND4J's ``AffinityManager``
+(``deeplearning4j-nn/.../iterator/AsyncDataSetIterator.java:75-76``) and moves
+data host->device implicitly inside every INDArray op. Here the substrate is
+JAX itself: arrays are ``jax.Array`` in HBM, placement is declarative through
+``jax.sharding``. This module is the single place the framework asks "what
+hardware do I have and how do I lay a mesh over it".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical mesh-axis names used across the framework.  Data parallelism is
+# always the leading 'data' axis; 'model' shards weights (TP); 'seq' shards
+# the time axis (sequence/context parallelism — ring attention).
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_SEQ = "seq"
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def default_backend() -> str:
+    return jax.default_backend()
+
+
+def default_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    data: Optional[int] = None,
+    model: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a named device mesh laid out so that collectives ride ICI.
+
+    Axes: ('data', 'model', 'seq').  By default every device goes to the
+    data axis (pure DP — the reference's only parallelism strategy, see
+    SURVEY.md §2 parallelism inventory).  TP/SP are first-class axes so
+    shardings compose: a (8,) slice can run as data=2, model=2, seq=2.
+    """
+    if devices is None:
+        devices = jax.devices()[: n_devices] if n_devices else jax.devices()
+    n = len(devices)
+    if data is None:
+        if n % (model * seq) != 0:
+            raise ValueError(f"{n} devices not divisible by model*seq={model * seq}")
+        data = n // (model * seq)
+    if data * model * seq != n:
+        raise ValueError(f"mesh {data}x{model}x{seq} != {n} devices")
+    import numpy as np
+
+    dev_array = np.asarray(devices).reshape(data, model, seq)
+    return Mesh(dev_array, (AXIS_DATA, AXIS_MODEL, AXIS_SEQ))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, P(AXIS_DATA))
+
+
+@dataclasses.dataclass(frozen=True)
+class DTypePolicy:
+    """Mixed-precision policy.
+
+    TPU MXU natively computes bf16 x bf16 -> f32.  The policy keeps params
+    and optimizer state in f32 (master weights), casts activations/compute
+    to ``compute_dtype``, and accumulates in f32.  The reference is f32/f64
+    via ND4J's global dtype (no mixed precision existed); ``float32`` policy
+    reproduces that exactly for parity tests.
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    accum_dtype: jnp.dtype = jnp.float32
+
+    def cast_input(self, x):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(self.compute_dtype)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+            else a,
+            x,
+        )
+
+
+_POLICIES = {
+    "float32": DTypePolicy(),
+    "bfloat16": DTypePolicy(compute_dtype=jnp.bfloat16),
+}
+_current_policy = _POLICIES[os.environ.get("DL4J_TPU_DTYPE", "float32")]
+
+
+def dtype_policy() -> DTypePolicy:
+    return _current_policy
+
+
+def set_dtype_policy(name: str) -> DTypePolicy:
+    global _current_policy
+    _current_policy = _POLICIES[name]
+    return _current_policy

@@ -1,0 +1,28 @@
+"""RNG-key discipline.
+
+The reference uses a stateful seeded RNG threaded through config
+(``NeuralNetConfiguration`` seed field) and ND4J's global RandomGenerator.
+JAX RNG is explicit-key; ``KeyStream`` is the stateful facade used at the
+*edges* (model init, data shuffling) while everything inside jit takes keys
+as arguments (e.g. dropout, RBM Gibbs sampling — reference
+``nn/layers/feedforward/rbm/RBM.java:223-282`` re-derived key-threaded).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class KeyStream:
+    """Stateful splitter over a root PRNG key — host-side use only."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+
+    def next(self) -> jax.Array:
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def next_n(self, n: int):
+        self._key, *subs = jax.random.split(self._key, n + 1)
+        return subs

@@ -1,0 +1,11 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import (
+    DataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+    IteratorDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator

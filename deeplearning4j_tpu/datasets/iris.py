@@ -1,0 +1,32 @@
+"""Iris iterator (reference ``IrisDataSetIterator`` /
+``datasets/fetchers/IrisDataFetcher.java``).  Data comes from sklearn's
+bundled copy of the classic UCI table (no network), normalized per-column
+like the reference fetcher."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+
+def iris_dataset(normalize: bool = True) -> DataSet:
+    from sklearn.datasets import load_iris
+
+    d = load_iris()
+    x = d.data.astype(np.float32)
+    if normalize:
+        x = (x - x.mean(0)) / x.std(0)
+    y = np.eye(3, dtype=np.float32)[d.target]
+    return DataSet(x, y)
+
+
+class IrisDataSetIterator(ListDataSetIterator):
+    def __init__(self, batch_size: int = 150, num_examples: int = 150,
+                 shuffle_seed: int = None):
+        data = iris_dataset()
+        if shuffle_seed is not None:
+            data = data.shuffle(np.random.RandomState(shuffle_seed))
+        data = data.subset(slice(0, num_examples))
+        super().__init__(data, batch_size)

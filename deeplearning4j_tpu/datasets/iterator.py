@@ -1,0 +1,254 @@
+"""DataSetIterator abstraction + async prefetch.
+
+Reference: ``datasets/iterator/DataSetIterator.java`` API (next/hasNext/reset/
+batch/totalExamples...), ``AsyncDataSetIterator.java:36-76`` (background
+thread + LinkedBlockingQueue prefetch — the thread boundary that overlaps
+host ETL with device compute), ``MultipleEpochsIterator``,
+``SamplingDataSetIterator``, ``IteratorDataSetIterator``.
+
+TPU note: prefetching matters *more* here than on the reference's CPU path —
+the jitted step returns control to Python while the TPU executes, so a
+prefetch thread keeps the input pipeline off the critical path.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterable over DataSet minibatches with reset semantics."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self.next()
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def batch(self) -> int:
+        raise NotImplementedError
+
+    def total_examples(self) -> Optional[int]:
+        return None
+
+    def input_columns(self) -> Optional[int]:
+        return None
+
+    def total_outcomes(self) -> Optional[int]:
+        return None
+
+    def async_supported(self) -> bool:
+        return True
+
+
+class ListDataSetIterator(DataSetIterator):
+    """In-memory list of examples batched to fixed size (reference
+    ``ListDataSetIterator``)."""
+
+    def __init__(self, data: DataSet, batch_size: int, drop_last: bool = False):
+        self._data = data
+        self._batch_size = batch_size
+        self._batches = data.batch_by(batch_size, drop_last)
+        self._pos = 0
+
+    def next(self) -> DataSet:
+        b = self._batches[self._pos]
+        self._pos += 1
+        return b
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._batches)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def batch(self) -> int:
+        return self._batch_size
+
+    def total_examples(self):
+        return len(self._data)
+
+    def input_columns(self):
+        return int(np.prod(self._data.features.shape[1:]))
+
+    def total_outcomes(self):
+        return int(self._data.labels.shape[-1])
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batch an iterator of single examples / odd-sized DataSets into
+    fixed minibatches (reference ``IteratorDataSetIterator``)."""
+
+    def __init__(self, source, batch_size: int):
+        self._source_factory = source if callable(source) else None
+        self._source_list = None if callable(source) else list(source)
+        self._batch_size = batch_size
+        self.reset()
+
+    def reset(self):
+        src = self._source_factory() if self._source_factory else iter(self._source_list)
+        self._iter = iter(src)
+        self._buffer: List[DataSet] = []
+        self._exhausted = False
+        self._pending: Optional[DataSet] = None
+        self._fill()
+
+    def _fill(self):
+        count = sum(len(d) for d in self._buffer)
+        while count < self._batch_size and not self._exhausted:
+            try:
+                d = next(self._iter)
+                self._buffer.append(d)
+                count += len(d)
+            except StopIteration:
+                self._exhausted = True
+        if self._buffer:
+            merged = DataSet.merge(self._buffer) if len(self._buffer) > 1 else self._buffer[0]
+            if len(merged) > self._batch_size:
+                self._pending = merged.subset(slice(self._batch_size, None))
+                merged = merged.subset(slice(0, self._batch_size))
+            self._buffer = [merged]
+
+    def has_next(self):
+        return bool(self._buffer)
+
+    def next(self):
+        out = self._buffer.pop(0)
+        if self._pending is not None:
+            self._buffer = [self._pending]
+            self._pending = None
+            self._fill()
+        else:
+            self._fill()
+        return out
+
+    def batch(self):
+        return self._batch_size
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays an underlying iterator N times (reference
+    ``MultipleEpochsIterator``)."""
+
+    def __init__(self, epochs: int, underlying: DataSetIterator):
+        self.epochs = epochs
+        self.underlying = underlying
+        self._epoch = 0
+
+    def has_next(self):
+        if self.underlying.has_next():
+            return True
+        if self._epoch + 1 < self.epochs:
+            self._epoch += 1
+            self.underlying.reset()
+            return self.underlying.has_next()
+        return False
+
+    def next(self):
+        return self.underlying.next()
+
+    def reset(self):
+        self._epoch = 0
+        self.underlying.reset()
+
+    def batch(self):
+        return self.underlying.batch()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Draws random with-replacement minibatches (reference
+    ``SamplingDataSetIterator``)."""
+
+    def __init__(self, data: DataSet, batch_size: int, total_batches: int, seed: int = 0):
+        self._data = data
+        self._batch_size = batch_size
+        self._total = total_batches
+        self._seed = seed
+        self.reset()
+
+    def reset(self):
+        self._rng = np.random.RandomState(self._seed)
+        self._count = 0
+
+    def has_next(self):
+        return self._count < self._total
+
+    def next(self):
+        idx = self._rng.randint(0, len(self._data), self._batch_size)
+        self._count += 1
+        return self._data.subset(idx)
+
+    def batch(self):
+        return self._batch_size
+
+
+_SENTINEL = object()
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with a bounded queue (reference
+    ``AsyncDataSetIterator.java:36-76``: LinkedBlockingQueue(prefetch) + a
+    producer thread).  Wraps any DataSetIterator; ``fit`` wraps its input in
+    this automatically like the reference's ``fit(DataSetIterator)`` :1032."""
+
+    def __init__(self, underlying: DataSetIterator, prefetch_size: int = 2):
+        self.underlying = underlying
+        self.prefetch = prefetch_size
+        self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_size)
+        self._thread: Optional[threading.Thread] = None
+        self._next_item = _SENTINEL
+        self._start()
+
+    def _start(self):
+        self._queue = queue.Queue(maxsize=self.prefetch)
+
+        def run():
+            try:
+                while self.underlying.has_next():
+                    self._queue.put(self.underlying.next())
+            finally:
+                self._queue.put(_SENTINEL)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        self._next_item = self._queue.get()
+
+    def has_next(self):
+        return self._next_item is not _SENTINEL
+
+    def next(self):
+        item = self._next_item
+        if item is _SENTINEL:
+            raise StopIteration
+        self._next_item = self._queue.get()
+        return item
+
+    def reset(self):
+        if self._thread is not None and self._thread.is_alive():
+            # drain so the producer can finish
+            while self._next_item is not _SENTINEL:
+                self._next_item = self._queue.get()
+            self._thread.join(timeout=5)
+        self.underlying.reset()
+        self._start()
+
+    def batch(self):
+        return self.underlying.batch()

@@ -1,0 +1,111 @@
+"""Numerical gradient checking — the framework's correctness oracle.
+
+Reference: ``gradientcheck/GradientCheckUtil.java`` — central-difference
+numerical gradient vs analytic backprop per parameter, with
+maxRelError/minAbsoluteError thresholds; used by every layer test suite
+(``GradientCheckTests.java``, ``CNNGradientCheckTest.java``, ...).
+
+Here the analytic side is ``jax.grad`` of the model's loss; the numerical
+side perturbs parameters by ±epsilon in float64.  TPU-native twist on the
+reference's per-parameter Java loop: all perturbed losses are evaluated by
+ONE vmapped/jitted XLA call over a batch of perturbed flat param vectors —
+hundreds of central differences per device launch instead of two.
+
+Passing this check proves the whole forward graph (layers, preprocessors,
+masking, losses) differentiates correctly — the same evidence triangle the
+reference's test suite rests on.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradients(
+    net,
+    x,
+    y,
+    *,
+    epsilon: float = 1e-6,
+    max_rel_error: float = 1e-3,
+    min_abs_error: float = 1e-8,
+    fmask=None,
+    lmask=None,
+    max_params_per_array: Optional[int] = 64,
+    seed: int = 0,
+    print_results: bool = False,
+    chunk: int = 512,
+) -> bool:
+    """Central-difference check of d(loss)/d(params) for a MultiLayerNetwork
+    or ComputationGraph facade (anything exposing _loss_fn/params/net_state).
+
+    Checks up to ``max_params_per_array`` randomly-chosen entries per param
+    tensor (None = all) — sampling keeps suites fast while covering every
+    tensor; the batched evaluation makes even full checks tractable.
+    """
+    x = jnp.asarray(x)
+    y = jnp.asarray(y, x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else None)
+
+    flat_params, treedef = jax.tree_util.tree_flatten(net.params)
+    sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in flat_params]
+    offsets = np.cumsum([0] + sizes)
+    total = int(offsets[-1])
+    vec0 = np.concatenate(
+        [np.asarray(p, np.float64).reshape(-1) for p in flat_params]
+    )
+
+    def loss_of_vec(vec):
+        leaves = [
+            vec[offsets[i] : offsets[i + 1]].reshape(flat_params[i].shape).astype(flat_params[i].dtype)
+            for i in range(len(flat_params))
+        ]
+        params = jax.tree_util.tree_unflatten(treedef, leaves)
+        l, _ = net._loss_fn(params, net.net_state, x, y, None, fmask, lmask, train=False)
+        return l
+
+    analytic = np.asarray(
+        jax.jit(jax.grad(loss_of_vec))(jnp.asarray(vec0)), np.float64
+    )
+
+    # choose indices to check
+    rng = np.random.RandomState(seed)
+    check_idx = []
+    for i, size in enumerate(sizes):
+        if size == 0:
+            continue
+        idxs = np.arange(size)
+        if max_params_per_array is not None and size > max_params_per_array:
+            idxs = rng.choice(size, max_params_per_array, replace=False)
+        check_idx.extend(offsets[i] + idxs)
+    check_idx = np.asarray(sorted(check_idx))
+
+    # batched central differences: rows = [+eps at i, -eps at i, ...]
+    batched_loss = jax.jit(jax.vmap(loss_of_vec))
+    numeric = np.empty(len(check_idx), np.float64)
+    for c0 in range(0, len(check_idx), chunk):
+        ids = check_idx[c0 : c0 + chunk]
+        pert = np.repeat(vec0[None, :], 2 * len(ids), axis=0)
+        rows = np.arange(len(ids))
+        pert[2 * rows, ids] += epsilon
+        pert[2 * rows + 1, ids] -= epsilon
+        vals = np.asarray(batched_loss(jnp.asarray(pert)), np.float64)
+        numeric[c0 : c0 + len(ids)] = (vals[0::2] - vals[1::2]) / (2 * epsilon)
+
+    ana = analytic[check_idx]
+    denom = np.maximum(np.abs(numeric), np.abs(ana))
+    rel = np.where(denom > 0, np.abs(numeric - ana) / np.maximum(denom, 1e-300), 0.0)
+    ok = (rel < max_rel_error) | (np.abs(numeric - ana) < min_abs_error)
+    n_fail = int((~ok).sum())
+
+    if print_results or n_fail:
+        print(f"GradientCheck: {len(ok) - n_fail} passed, {n_fail} failed")
+        for j in np.nonzero(~ok)[0][:20]:
+            print(
+                f"  flat idx {check_idx[j]}: analytic={ana[j]:.8g} "
+                f"numeric={numeric[j]:.8g} rel={rel[j]:.4g}"
+            )
+    return n_fail == 0

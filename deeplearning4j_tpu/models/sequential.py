@@ -1,0 +1,372 @@
+"""MultiLayerNetwork — the sequential-network facade.
+
+Reference: ``nn/multilayer/MultiLayerNetwork.java`` (init :348, fit :1029,
+feedForward :619-711, backprop :1085, TBPTT :1176, output :1525-1607,
+rnnTimeStep :2195).  Functional redesign: params/state live in pytrees on
+this facade; the training step is ONE jitted pure function
+(loss -> jax.grad -> updater -> param update), replacing the reference's
+Solver/StochasticGradientDescent object dance (``optimize/solvers/
+StochasticGradientDescent.java:51-73``) with an XLA program.  The
+reference's flattened-params invariant (single param vector,
+``MultiLayerNetwork.java:97-98``) survives as ``params_to_vector`` /
+``set_params_vector`` — used by serialization, param averaging, and tests.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.backend.rng import KeyStream
+from deeplearning4j_tpu.nn import losses as losses_mod
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.dense import OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.optimize import updaters as upd
+
+
+def _is_recurrent(layer) -> bool:
+    return hasattr(layer, "apply_with_carry")
+
+
+class MultiLayerNetwork:
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: Tuple[Layer, ...] = conf.layers
+        self.params: Dict[str, Dict[str, jax.Array]] = {}
+        self.net_state: Dict[str, Dict[str, jax.Array]] = {}
+        self.updater_state: Dict[str, Any] = {}
+        self.listeners: List[Any] = []
+        self.iteration = 0
+        self.score_value: float = float("nan")
+        self._keys = KeyStream(conf.seed)
+        self._jit_cache: Dict[Any, Any] = {}
+        # streaming rnnTimeStep state: layer_name -> carry
+        self._rnn_state: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ init
+    def init(self, dtype=jnp.float32) -> "MultiLayerNetwork":
+        params, net_state = {}, {}
+        for layer in self.layers:
+            if layer.has_params():
+                params[layer.name] = layer.init(self._keys.next(), dtype)
+            else:
+                params[layer.name] = {}
+            st = layer.init_state()
+            if st:
+                net_state[layer.name] = jax.tree_util.tree_map(
+                    lambda a: a.astype(dtype), st
+                )
+        self.params = params
+        self.net_state = net_state
+        self.updater_state = upd.init_state(self.conf.updater, self._trainable(params))
+        return self
+
+    def _trainable(self, params):
+        return {k: v for k, v in params.items() if v}
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape)) for l in self.params.values() for p in l.values())
+
+    # ----------------------------------------------------- flattened params
+    def params_to_vector(self) -> np.ndarray:
+        """Single flat param vector (reference flattenedParams invariant)."""
+        leaves = jax.tree_util.tree_leaves(self.params)
+        if not leaves:
+            return np.zeros((0,), np.float32)
+        return np.concatenate([np.asarray(l).reshape(-1) for l in leaves])
+
+    def set_params_vector(self, vec: np.ndarray) -> None:
+        leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        out, off = [], 0
+        for l in leaves:
+            n = int(np.prod(l.shape))
+            out.append(jnp.asarray(vec[off : off + n], l.dtype).reshape(l.shape))
+            off += n
+        if off != vec.size:
+            raise ValueError(f"param vector size {vec.size} != model size {off}")
+        self.params = jax.tree_util.tree_unflatten(treedef, out)
+
+    # --------------------------------------------------------------- forward
+    def _forward(self, params, net_state, x, *, train, rng, fmask=None,
+                 carries=None, collect=False):
+        """Pure forward through preprocessors + layers.
+
+        Returns (last_pre_activation_input, activations list if collect,
+        new_net_state, new_carries).  The output layer is applied EXCEPT its
+        loss head; callers use layer.pre_output for scoring/inference.
+        """
+        acts = []
+        new_state = dict(net_state)
+        new_carries = {}
+        h = x
+        n = len(self.layers)
+        rngs = jax.random.split(rng, n) if rng is not None else [None] * n
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                h = self.conf.preprocessors[i](h)
+            lstate = net_state.get(layer.name, {})
+            if _is_recurrent(layer):
+                carry = (carries or {}).get(layer.name)
+                h, lst, new_carry = layer.apply_with_carry(
+                    params[layer.name], lstate, h, carry,
+                    train=train, rng=rngs[i], mask=fmask,
+                )
+                new_carries[layer.name] = new_carry
+            elif isinstance(layer, (OutputLayer,)):
+                # output head: stop at preoutput; activation applied on demand
+                h = self.maybe_flatten_time(layer, h)
+                h = layer.maybe_dropout(h, train=train, rng=rngs[i])
+                h = layer.pre_output(params[layer.name], h)
+            else:
+                h, lst = layer.apply(params[layer.name], lstate, h,
+                                     train=train, rng=rngs[i])
+                if lst:
+                    new_state[layer.name] = lst
+            if collect:
+                acts.append(h)
+        return h, acts, new_state, new_carries
+
+    @staticmethod
+    def maybe_flatten_time(layer, h):
+        return h
+
+    # ----------------------------------------------------------------- score
+    def _loss_fn(self, params, net_state, x, y, rng, fmask=None, lmask=None,
+                 carries=None, train=True):
+        out_layer = self.layers[-1]
+        if not isinstance(out_layer, OutputLayer):
+            raise ValueError("Last layer must be an OutputLayer/RnnOutputLayer for fit()")
+        pre, _, new_state, new_carries = self._forward(
+            params, net_state, x, train=train, rng=rng, fmask=fmask, carries=carries
+        )
+        data_loss = losses_mod.score(out_layer.loss, y, pre, out_layer.activation, lmask)
+        reg = jnp.zeros(())
+        for layer in self.layers:
+            if layer.has_params():
+                reg = reg + layer.reg_score(params[layer.name])
+        return data_loss + reg, (new_state, new_carries)
+
+    # ------------------------------------------------------------ train step
+    def _make_train_step(self, with_carry: bool):
+        updater_cfg = self.conf.updater
+        lr_overrides = {
+            l.name: l.learning_rate for l in self.layers if l.learning_rate is not None
+        }
+
+        def step(params, upd_state, net_state, iteration, x, y, rng, fmask, lmask, carries):
+            (loss, (new_net_state, new_carries)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(params, net_state, x, y, rng, fmask, lmask, carries)
+            grads = {k: v for k, v in grads.items() if v}
+            updates, new_upd_state = upd.update(
+                updater_cfg, grads, upd_state, iteration, lr_overrides
+            )
+            new_params = dict(params)
+            for lname, u in updates.items():
+                new_params[lname] = {
+                    p: params[lname][p] - u[p] for p in u
+                }
+            return new_params, new_upd_state, new_net_state, loss, new_carries
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self, with_carry=False):
+        key = ("train_step", with_carry)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._make_train_step(with_carry)
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, *, fmask=None, lmask=None, epochs: int = 1):
+        """Train.  ``data`` is a DataSetIterator-style iterable of
+        (features, labels[, fmask, lmask]) tuples, or a single (X, y) pair.
+        Reference: ``MultiLayerNetwork.fit(DataSetIterator)`` :1029."""
+        if labels is not None:
+            batches = [(data, labels, fmask, lmask)]
+            self._fit_batches(batches)
+            return self
+        for _ in range(epochs):
+            self._fit_batches(data)
+        return self
+
+    def _fit_batches(self, batches):
+        step = self._get_train_step()
+        tbptt = self.conf.backprop_type == "truncated_bptt"
+        for batch in batches:
+            x, y, fm, lm = self._unpack(batch)
+            for _ in range(self.conf.num_iterations):
+                if tbptt:
+                    self._fit_tbptt(step, x, y, fm, lm)
+                else:
+                    self._one_step(step, x, y, fm, lm, carries=None)
+
+    def _one_step(self, step, x, y, fm, lm, carries):
+        rng = self._keys.next()
+        it = jnp.asarray(self.iteration, jnp.float32)
+        (self.params, self.updater_state, self.net_state, loss, new_carries) = step(
+            self.params, self.updater_state, self.net_state, it,
+            jnp.asarray(x), jnp.asarray(y), rng,
+            None if fm is None else jnp.asarray(fm),
+            None if lm is None else jnp.asarray(lm),
+            carries,
+        )
+        self.score_value = float(loss)
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration)
+        return new_carries
+
+    def _fit_tbptt(self, step, x, y, fm, lm):
+        """Truncated BPTT: slice the time axis into fwd-length windows,
+        carrying RNN state (detached) across windows.
+        Reference ``doTruncatedBPTT`` ``MultiLayerNetwork.java:1176``."""
+        T = x.shape[1]
+        L = self.conf.tbptt_fwd_length
+        carries = None
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            carries = self._one_step(
+                step, x[:, sl], y[:, sl],
+                None if fm is None else fm[:, sl],
+                None if lm is None else lm[:, sl],
+                carries,
+            )
+            carries = jax.lax.stop_gradient(carries)
+
+    @staticmethod
+    def _unpack(batch):
+        if isinstance(batch, (tuple, list)):
+            if len(batch) == 2:
+                return batch[0], batch[1], None, None
+            if len(batch) == 4:
+                return batch
+        if hasattr(batch, "features"):
+            return batch.features, batch.labels, getattr(batch, "features_mask", None), getattr(batch, "labels_mask", None)
+        raise ValueError(f"Cannot unpack batch of type {type(batch)}")
+
+    # ------------------------------------------------------------- inference
+    def _output_fn(self):
+        if "output" not in self._jit_cache:
+
+            def out(params, net_state, x, fmask):
+                pre, _, _, _ = self._forward(params, net_state, x, train=False,
+                                             rng=None, fmask=fmask)
+                from deeplearning4j_tpu.nn import activations
+
+                return activations.get(self.layers[-1].activation)(pre)
+
+            self._jit_cache["output"] = jax.jit(out)
+        return self._jit_cache["output"]
+
+    def output(self, x, fmask=None):
+        """Inference forward (reference ``output`` :1525-1607, TEST mode)."""
+        return self._output_fn()(self.params, self.net_state, jnp.asarray(x),
+                                 None if fmask is None else jnp.asarray(fmask))
+
+    def feed_forward(self, x, train: bool = False):
+        """All layer activations (reference ``feedForward`` :619-688)."""
+        rng = self._keys.next() if train else None
+        pre, acts, _, _ = self._forward(self.params, self.net_state,
+                                        jnp.asarray(x), train=train, rng=rng,
+                                        collect=True)
+        return acts
+
+    def score(self, x=None, y=None, dataset=None, fmask=None, lmask=None) -> float:
+        if dataset is not None:
+            x, y = dataset[0], dataset[1]
+        loss, _ = self._loss_fn(self.params, self.net_state, jnp.asarray(x),
+                                jnp.asarray(y), None, fmask, lmask, train=False)
+        return float(loss)
+
+    # ------------------------------------------------- streaming rnnTimeStep
+    def rnn_clear_previous_state(self):
+        self._rnn_state = {}
+
+    def rnn_time_step(self, x):
+        """Stateful streaming inference (reference ``rnnTimeStep`` :2195):
+        feeds one (or a few) timesteps, carries hidden state between calls."""
+        x = jnp.asarray(x)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        carries = self._rnn_state or None
+        pre, _, _, new_carries = self._forward(
+            self.params, self.net_state, x, train=False, rng=None, carries=carries
+        )
+        self._rnn_state = new_carries
+        from deeplearning4j_tpu.nn import activations
+
+        out = activations.get(self.layers[-1].activation)(pre)
+        return out[:, -1] if squeeze and out.ndim == 3 else out
+
+    # ------------------------------------------------------------- pretrain
+    def pretrain(self, batches, epochs: int = 1):
+        """Layerwise unsupervised pretraining (reference ``pretrain``
+        ``MultiLayerNetwork.java:164``; RBM/AutoEncoder objectives)."""
+        from deeplearning4j_tpu.nn.layers.autoencoder import AutoEncoder, RBM
+
+        batches = list(batches) if not isinstance(batches, list) else batches
+        for i, layer in enumerate(self.layers):
+            if not isinstance(layer, (AutoEncoder, RBM)):
+                continue
+
+            def ploss(lparams, x, rng, _layer=layer):
+                return _layer.pretrain_loss(lparams, x, rng)
+
+            grad_fn = jax.jit(jax.value_and_grad(ploss))
+            lr = layer.learning_rate or self.conf.updater.learning_rate
+            for _ in range(epochs):
+                for batch in batches:
+                    x = jnp.asarray(self._unpack(batch)[0])
+                    # feed through earlier layers (test mode)
+                    for j in range(i):
+                        if j in self.conf.preprocessors:
+                            x = self.conf.preprocessors[j](x)
+                        x, _ = self.layers[j].apply(
+                            self.params[self.layers[j].name],
+                            self.net_state.get(self.layers[j].name, {}),
+                            x, train=False, rng=None,
+                        )
+                    if i in self.conf.preprocessors:
+                        x = self.conf.preprocessors[i](x)
+                    loss, g = grad_fn(self.params[layer.name], x, self._keys.next())
+                    self.params[layer.name] = jax.tree_util.tree_map(
+                        lambda p, gg: p - lr * gg, self.params[layer.name], g
+                    )
+        return self
+
+    # ------------------------------------------------------------- listeners
+    def set_listeners(self, *listeners):
+        self.listeners = list(listeners)
+        return self
+
+    def add_listener(self, listener):
+        self.listeners.append(listener)
+        return self
+
+    # ------------------------------------------------------------------ io
+    def save(self, path, save_updater: bool = True):
+        from deeplearning4j_tpu.models import serialization
+
+        serialization.write_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path) -> "MultiLayerNetwork":
+        from deeplearning4j_tpu.models import serialization
+
+        return serialization.restore_multi_layer_network(path)
+
+    def clone(self) -> "MultiLayerNetwork":
+        net = MultiLayerNetwork(self.conf)
+        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        net.net_state = jax.tree_util.tree_map(lambda a: a, self.net_state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+        net.iteration = self.iteration
+        return net

@@ -1,0 +1,337 @@
+"""Config system: fluent builder DSL -> immutable config, JSON round-trip.
+
+Reference: ``nn/conf/NeuralNetConfiguration.java:413-449`` (Builder with
+defaults: activation "sigmoid", WeightInit.XAVIER, lr 0.1, Updater SGD,
+OptimizationAlgorithm STOCHASTIC_GRADIENT_DESCENT), per-layer overrides,
+Jackson JSON/YAML round-trip (``MultiLayerConfiguration.java:75-120``),
+structural validation (``ComputationGraphConfiguration.java:211``).
+
+The JSON document is this framework's canonical persistent config form —
+the ``configuration.json`` member of checkpoint archives (see
+``models/serialization.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+from deeplearning4j_tpu.nn.preprocessors import (
+    Preprocessor,
+    auto_preprocessor,
+    preproc_from_dict,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdaterConfig:
+    """Updater + schedule hyperparameters (reference ``nn/conf/Updater.java``
+    enum + lr/momentum schedule maps on the Builder)."""
+
+    name: str = "sgd"  # sgd|adam|adagrad|adadelta|nesterovs|rmsprop|none
+    learning_rate: float = 0.1
+    momentum: float = 0.9          # nesterovs
+    rho: float = 0.95              # adadelta
+    rmsprop_decay: float = 0.95    # rmsprop (reference rmsDecay)
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    epsilon: float = 1e-8
+    # learning-rate decay policy (reference LearningRatePolicy enum)
+    lr_policy: str = "none"        # none|exponential|inverse|step|poly|sigmoid|schedule
+    lr_policy_decay_rate: float = 0.0
+    lr_policy_steps: float = 1.0
+    lr_policy_power: float = 1.0
+    lr_schedule: Optional[Dict[int, float]] = None     # iteration -> lr
+    momentum_schedule: Optional[Dict[int, float]] = None
+    # gradient clipping/normalization (reference GradientNormalization enum)
+    gradient_normalization: str = "none"  # none|renormalize_l2_per_layer|renormalize_l2_per_param_type|clip_element_wise_absolute_value|clip_l2_per_layer|clip_l2_per_param_type
+    gradient_normalization_threshold: float = 1.0
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        if d["lr_schedule"]:
+            d["lr_schedule"] = {str(k): v for k, v in d["lr_schedule"].items()}
+        if d["momentum_schedule"]:
+            d["momentum_schedule"] = {str(k): v for k, v in d["momentum_schedule"].items()}
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        for k in ("lr_schedule", "momentum_schedule"):
+            if d.get(k):
+                d[k] = {int(i): v for i, v in d[k].items()}
+        return UpdaterConfig(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiLayerConfiguration:
+    """Completed, immutable network config (reference
+    ``nn/conf/MultiLayerConfiguration.java``)."""
+
+    layers: Tuple[Layer, ...]
+    preprocessors: Dict[int, Preprocessor]
+    input_type: Optional[InputType]
+    updater: UpdaterConfig
+    seed: int = 12345
+    optimization_algo: str = "stochastic_gradient_descent"
+    num_iterations: int = 1         # reference iterations-per-minibatch default 1 (hot loop count)
+    backprop_type: str = "standard"  # standard | truncated_bptt
+    tbptt_fwd_length: int = 20
+    tbptt_back_length: int = 20
+    pretrain: bool = False
+    backprop: bool = True
+
+    # ---- serde ----------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "format_version": 1,
+            "layers": [l.to_dict() for l in self.layers],
+            "preprocessors": {str(i): p.to_dict() for i, p in self.preprocessors.items()},
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "updater": self.updater.to_dict(),
+            "seed": self.seed,
+            "optimization_algo": self.optimization_algo,
+            "num_iterations": self.num_iterations,
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "pretrain": self.pretrain,
+            "backprop": self.backprop,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(
+            layers=tuple(layer_from_dict(ld) for ld in d["layers"]),
+            preprocessors={int(i): preproc_from_dict(pd) for i, pd in d["preprocessors"].items()},
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            updater=UpdaterConfig.from_dict(d["updater"]),
+            seed=d["seed"],
+            optimization_algo=d["optimization_algo"],
+            num_iterations=d["num_iterations"],
+            backprop_type=d["backprop_type"],
+            tbptt_fwd_length=d["tbptt_fwd_length"],
+            tbptt_back_length=d["tbptt_back_length"],
+            pretrain=d.get("pretrain", False),
+            backprop=d.get("backprop", True),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+
+class ListBuilder:
+    """Layer-stack builder (reference ``NeuralNetConfiguration.ListBuilder``)."""
+
+    def __init__(self, parent: "Builder"):
+        self._parent = parent
+        self._layers: List[Layer] = []
+        self._preprocessors: Dict[int, Preprocessor] = {}
+        self._input_type: Optional[InputType] = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+        self._pretrain = False
+        self._backprop = True
+
+    def layer(self, layer: Layer, index: Optional[int] = None) -> "ListBuilder":
+        if index is not None and index != len(self._layers):
+            raise ValueError(f"layers must be added in order; expected {len(self._layers)}, got {index}")
+        self._layers.append(layer)
+        return self
+
+    def input_preprocessor(self, index: int, preproc: Preprocessor) -> "ListBuilder":
+        self._preprocessors[index] = preproc
+        return self
+
+    def set_input_type(self, t: InputType) -> "ListBuilder":
+        self._input_type = t
+        return self
+
+    def backprop_type(self, kind: str, fwd_length: int = 20, back_length: int = 20) -> "ListBuilder":
+        self._backprop_type = kind
+        self._tbptt_fwd = fwd_length
+        self._tbptt_back = back_length
+        return self
+
+    def pretrain(self, flag: bool) -> "ListBuilder":
+        self._pretrain = flag
+        return self
+
+    def backprop(self, flag: bool) -> "ListBuilder":
+        self._backprop = flag
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        if not self._layers:
+            raise ValueError("No layers added")
+        p = self._parent
+        layers: List[Layer] = []
+        cur_type = self._input_type
+        for i, layer in enumerate(self._layers):
+            layer = p._apply_global_defaults(layer)
+            if layer.name is None:
+                layer = layer.with_name(f"layer_{i}")
+            if cur_type is not None:
+                if i not in self._preprocessors:
+                    pre = auto_preprocessor(cur_type, layer)
+                    if pre is not None:
+                        self._preprocessors[i] = pre
+                if i in self._preprocessors:
+                    cur_type = self._preprocessors[i].output_type(cur_type)
+                layer = layer.setup(cur_type)
+                cur_type = layer.output_type(cur_type)
+            else:
+                # no input type: n_in must be fully specified by the user
+                if getattr(layer, "n_in", 0) is None:
+                    raise ValueError(
+                        f"Layer {i} ({type(layer).__name__}) has no n_in and no "
+                        f"input_type was set for inference"
+                    )
+            layers.append(layer)
+        return MultiLayerConfiguration(
+            layers=tuple(layers),
+            preprocessors=dict(self._preprocessors),
+            input_type=self._input_type,
+            updater=p._updater,
+            seed=p._seed,
+            optimization_algo=p._optimization_algo,
+            num_iterations=p._num_iterations,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+            pretrain=self._pretrain,
+            backprop=self._backprop,
+        )
+
+
+class Builder:
+    """Global-hyperparameter builder (reference
+    ``NeuralNetConfiguration.Builder``).  Global activation/weight-init/l1/l2/
+    dropout are applied to layers that did not override them."""
+
+    def __init__(self):
+        self._seed = 12345
+        self._updater = UpdaterConfig()
+        self._optimization_algo = "stochastic_gradient_descent"
+        self._num_iterations = 1
+        self._activation: Optional[str] = None
+        self._weight_init: Optional[str] = None
+        self._dist: Optional[dict] = None
+        self._l1: Optional[float] = None
+        self._l2: Optional[float] = None
+        self._dropout: Optional[float] = None
+        self._regularization = False
+
+    def seed(self, s: int) -> "Builder":
+        self._seed = int(s)
+        return self
+
+    def updater(self, name: str, **kwargs) -> "Builder":
+        self._updater = dataclasses.replace(self._updater, name=name.lower(), **kwargs)
+        return self
+
+    def learning_rate(self, lr: float) -> "Builder":
+        self._updater = dataclasses.replace(self._updater, learning_rate=lr)
+        return self
+
+    def momentum(self, m: float) -> "Builder":
+        self._updater = dataclasses.replace(self._updater, momentum=m)
+        return self
+
+    def lr_policy(self, policy: str, **kwargs) -> "Builder":
+        kw = {"lr_policy": policy}
+        kw.update({f"lr_policy_{k}": v for k, v in kwargs.items()})
+        self._updater = dataclasses.replace(self._updater, **kw)
+        return self
+
+    def lr_schedule(self, schedule: Dict[int, float]) -> "Builder":
+        self._updater = dataclasses.replace(
+            self._updater, lr_policy="schedule", lr_schedule=dict(schedule)
+        )
+        return self
+
+    def gradient_normalization(self, kind: str, threshold: float = 1.0) -> "Builder":
+        self._updater = dataclasses.replace(
+            self._updater,
+            gradient_normalization=kind,
+            gradient_normalization_threshold=threshold,
+        )
+        return self
+
+    def optimization_algo(self, algo: str) -> "Builder":
+        self._optimization_algo = algo.lower()
+        return self
+
+    def iterations(self, n: int) -> "Builder":
+        self._num_iterations = n
+        return self
+
+    def activation(self, a: str) -> "Builder":
+        self._activation = a
+        return self
+
+    def weight_init(self, w: str, dist=None) -> "Builder":
+        self._weight_init = w
+        self._dist = dist.to_dict() if dist is not None and hasattr(dist, "to_dict") else dist
+        return self
+
+    def regularization(self, flag: bool) -> "Builder":
+        self._regularization = flag
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._l1 = v
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._l2 = v
+        return self
+
+    def dropout(self, v: float) -> "Builder":
+        self._dropout = v
+        return self
+
+    def list(self) -> ListBuilder:
+        return ListBuilder(self)
+
+    # graph() added by models/graph.py (ComputationGraph facade)
+    def graph(self):
+        from deeplearning4j_tpu.models.graph import GraphBuilder
+
+        return GraphBuilder(self)
+
+    def _apply_global_defaults(self, layer: Layer) -> Layer:
+        """Push builder globals into layer fields that are at class default —
+        the reference's layerwise-override semantics."""
+        updates = {}
+        for field, glob in (
+            ("activation", self._activation),
+            ("weight_init", self._weight_init),
+            ("dist", self._dist),
+            ("l1", self._l1 if self._regularization else None),
+            ("l2", self._l2 if self._regularization else None),
+            ("dropout", self._dropout),
+        ):
+            if glob is None or not hasattr(layer, field):
+                continue
+            cls_default = next(
+                (f.default for f in dataclasses.fields(layer) if f.name == field), None
+            )
+            if getattr(layer, field) == cls_default:
+                updates[field] = glob
+        return dataclasses.replace(layer, **updates) if updates else layer
+
+
+class NeuralNetConfiguration:
+    @staticmethod
+    def builder() -> Builder:
+        return Builder()

@@ -1,0 +1,119 @@
+"""Weight-init zoo — the reference's ``WeightInit`` enum re-derived.
+
+Reference: ``nn/weights/WeightInit.java:33`` (DISTRIBUTION, ZERO, SIGMOID_UNIFORM,
+UNIFORM, XAVIER, XAVIER_UNIFORM, XAVIER_FAN_IN, XAVIER_LEGACY, RELU, RELU_UNIFORM),
+applied by ``nn/weights/WeightInitUtil.java``.  fan_in/fan_out follow the
+reference convention: for a dense [n_in, n_out] kernel fan_in=n_in,
+fan_out=n_out; for conv kernels fan_in = in_ch * prod(kernel),
+fan_out = out_ch * prod(kernel).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: Sequence[int], fan_in: Optional[int], fan_out: Optional[int]) -> Tuple[int, int]:
+    if fan_in is not None and fan_out is not None:
+        return fan_in, fan_out
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernel HWIO: [kh, kw, in_ch, out_ch]
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def init(
+    name: str,
+    key: jax.Array,
+    shape: Sequence[int],
+    dtype=jnp.float32,
+    *,
+    fan_in: Optional[int] = None,
+    fan_out: Optional[int] = None,
+    distribution=None,
+):
+    """Materialise a weight tensor using the named scheme."""
+    name = name.lower()
+    fi, fo = _fans(shape, fan_in, fan_out)
+    shape = tuple(shape)
+
+    if name == "zero":
+        return jnp.zeros(shape, dtype)
+    if name == "ones":
+        return jnp.ones(shape, dtype)
+    if name == "uniform":
+        # reference: U(-a, a), a = 1/sqrt(fan_in)
+        a = 1.0 / math.sqrt(fi)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "xavier":
+        # reference XAVIER: gaussian, var = 2/(fan_in+fan_out)
+        std = math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype)
+    if name == "xavier_uniform":
+        a = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "xavier_fan_in":
+        std = math.sqrt(1.0 / fi)
+        return std * jax.random.normal(key, shape, dtype)
+    if name == "xavier_legacy":
+        std = math.sqrt(1.0 / (fi + fo))
+        return std * jax.random.normal(key, shape, dtype)
+    if name == "relu":
+        # He init: gaussian, var = 2/fan_in
+        std = math.sqrt(2.0 / fi)
+        return std * jax.random.normal(key, shape, dtype)
+    if name == "relu_uniform":
+        a = math.sqrt(6.0 / fi)
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "sigmoid_uniform":
+        a = 4.0 * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, -a, a)
+    if name == "normal":
+        return jax.random.normal(key, shape, dtype) / math.sqrt(fi)
+    if name == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit 'distribution' requires a distribution spec")
+        return distribution.sample(key, shape, dtype)
+    raise ValueError(f"Unknown weight init '{name}'")
+
+
+class NormalDistribution:
+    """Custom-distribution spec (reference ``nn/conf/distribution/``)."""
+
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def sample(self, key, shape, dtype):
+        return self.mean + self.std * jax.random.normal(key, shape, dtype)
+
+    def to_dict(self):
+        return {"type": "normal", "mean": self.mean, "std": self.std}
+
+
+class UniformDistribution:
+    def __init__(self, lower: float = -1.0, upper: float = 1.0):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, self.lower, self.upper)
+
+    def to_dict(self):
+        return {"type": "uniform", "lower": self.lower, "upper": self.upper}
+
+
+def distribution_from_dict(d):
+    if d is None:
+        return None
+    t = d["type"]
+    if t == "normal":
+        return NormalDistribution(d["mean"], d["std"])
+    if t == "uniform":
+        return UniformDistribution(d["lower"], d["upper"])
+    raise ValueError(f"Unknown distribution type {t}")

@@ -1,0 +1,20 @@
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer, layer_from_dict
+from deeplearning4j_tpu.nn.layers.dense import (
+    DenseLayer,
+    OutputLayer,
+    ActivationLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization,
+    LocalResponseNormalization,
+)
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    GravesLSTM,
+    GravesBidirectionalLSTM,
+    LSTM,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.layers.autoencoder import AutoEncoder, RBM

@@ -1,0 +1,137 @@
+"""Layer base abstraction — the functional re-design of the reference's
+``nn/api/Layer.java`` + one-config-class-per-layer (``nn/conf/layers/*.java``).
+
+A layer here is a *frozen config dataclass* exposing:
+  - ``setup(input_type)``  -> completed copy (n_in inferred) — replaces the
+    reference's ``ConvolutionLayerSetup``/``InputTypeUtil`` auto-wiring
+  - ``output_type(input_type)`` -> static shape inference
+  - ``init(key, dtype)``   -> parameter pytree (dict name->array) — replaces
+    ``ParamInitializer`` (``nn/params/*.java``)
+  - ``init_state()``       -> non-trainable state pytree (e.g. BN running stats)
+  - ``apply(params, state, x, *, train, rng)`` -> (y, new_state) — replaces
+    ``Layer.activate``; backprop is ``jax.grad`` through apply, replacing the
+    reference's hand-written ``backpropGradient`` chains.
+
+There is no mutable layer object holding params: params live in the model's
+pytree, so the whole train step jits to one XLA program and shards with pjit.
+
+Serialization: each class registers under its reference-style type name;
+``to_dict``/``layer_from_dict`` give the Jackson-subtype-registry equivalent
+(custom layers register the same way — ``register_layer``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+
+_LAYER_REGISTRY: Dict[str, Type["Layer"]] = {}
+
+
+def register_layer(cls: Type["Layer"]) -> Type["Layer"]:
+    """Class decorator: register a layer type for JSON round-trip
+    (the Jackson ``@JsonSubTypes`` equivalent; custom layers use this too,
+    mirroring the reference custom-layer tests ``nn/layers/custom/``)."""
+    _LAYER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def layer_from_dict(d: Dict[str, Any]) -> "Layer":
+    d = dict(d)
+    type_name = d.pop("type")
+    cls = _LAYER_REGISTRY.get(type_name)
+    if cls is None:
+        raise ValueError(f"Unknown layer type '{type_name}'; registered: {sorted(_LAYER_REGISTRY)}")
+    return cls.from_dict(d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """Base layer config. Fields every layer shares (reference
+    ``nn/conf/layers/Layer.java`` base: activation, weightInit, dropOut,
+    l1/l2, learning-rate overrides)."""
+
+    name: Optional[str] = None
+    activation: str = "sigmoid"
+    weight_init: str = "xavier"
+    dist: Optional[dict] = None        # distribution spec when weight_init="distribution"
+    dropout: float = 0.0               # input dropout probability (reference dropOut)
+    l1: float = 0.0
+    l2: float = 0.0
+    learning_rate: Optional[float] = None   # per-layer lr override
+    bias_init: float = 0.0
+
+    # ---- shape plumbing -------------------------------------------------
+    def setup(self, input_type: InputType) -> "Layer":
+        """Return a completed copy with sizes inferred from input_type."""
+        return self
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    # ---- params ---------------------------------------------------------
+    def init(self, key: jax.Array, dtype=jnp.float32) -> Dict[str, jax.Array]:
+        raise NotImplementedError
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        return {}
+
+    def has_params(self) -> bool:
+        return True
+
+    # ---- forward --------------------------------------------------------
+    def apply(
+        self,
+        params: Dict[str, jax.Array],
+        state: Dict[str, jax.Array],
+        x: jax.Array,
+        *,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+    ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+        raise NotImplementedError
+
+    def maybe_dropout(self, x, *, train, rng):
+        """Input dropout (reference ``util/Dropout.java:24-36`` applyDropout:
+        inverted dropout scaling at train time)."""
+        if not train or self.dropout <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"Layer {self.name}: dropout requires an rng key at train time")
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    # ---- regularization -------------------------------------------------
+    def reg_score(self, params: Dict[str, jax.Array]) -> jax.Array:
+        """L1/L2 penalty contribution (reference calcL1/calcL2 on weights only)."""
+        if (self.l1 == 0.0 and self.l2 == 0.0) or not params:
+            return jnp.zeros(())
+        total = jnp.zeros(())
+        for pname, p in params.items():
+            if pname in ("b", "beta", "gamma", "mean", "var"):
+                continue
+            if self.l1:
+                total = total + self.l1 * jnp.sum(jnp.abs(p))
+            if self.l2:
+                total = total + 0.5 * self.l2 * jnp.sum(p * p)
+        return total
+
+    # ---- serde ----------------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["type"] = type(self).__name__
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Layer":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+    def with_name(self, name: str) -> "Layer":
+        return dataclasses.replace(self, name=name)

@@ -1,0 +1,142 @@
+"""Loss-function zoo.
+
+Reference: ``LossFunctions.LossFunction`` used by output layers
+(``nn/layers/BaseOutputLayer.java``) and gradient-checked exhaustively by
+``LossFunctionGradientCheck.java``.  Every loss takes (labels, preoutput,
+activation_name, mask) and returns per-example scores; gradients come from
+``jax.grad`` over the mean score, replacing the reference's hand-derived
+``LossFunction.computeGradient`` implementations.
+
+Shapes: labels/preoutput are [batch, n_out] or [batch, time, n_out] for
+sequences; mask broadcasts over the trailing feature dim.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations
+
+_EPS = 1e-8
+
+
+def _activate(preout, activation: str):
+    return activations.get(activation)(preout)
+
+
+def mse(labels, preout, activation="identity", mask=None):
+    out = _activate(preout, activation)
+    per = jnp.sum((out - labels) ** 2, axis=-1)
+    return _apply_mask(per, mask)
+
+
+def l1(labels, preout, activation="identity", mask=None):
+    out = _activate(preout, activation)
+    per = jnp.sum(jnp.abs(out - labels), axis=-1)
+    return _apply_mask(per, mask)
+
+
+def l2(labels, preout, activation="identity", mask=None):
+    # reference L2 = sum of squared errors (no 1/n)
+    return mse(labels, preout, activation, mask)
+
+
+def xent(labels, preout, activation="sigmoid", mask=None):
+    """Binary cross-entropy (reference XENT)."""
+    out = _activate(preout, activation)
+    out = jnp.clip(out, _EPS, 1.0 - _EPS)
+    per = -jnp.sum(labels * jnp.log(out) + (1 - labels) * jnp.log(1 - out), axis=-1)
+    return _apply_mask(per, mask)
+
+
+def mcxent(labels, preout, activation="softmax", mask=None):
+    """Multi-class cross-entropy.  With softmax activation uses the fused
+    log-softmax path (numerically stable, single XLA fusion)."""
+    if activation == "softmax":
+        logp = jax.nn.log_softmax(preout, axis=-1)
+        per = -jnp.sum(labels * logp, axis=-1)
+    else:
+        out = jnp.clip(_activate(preout, activation), _EPS, 1.0)
+        per = -jnp.sum(labels * jnp.log(out), axis=-1)
+    return _apply_mask(per, mask)
+
+
+def negativeloglikelihood(labels, preout, activation="softmax", mask=None):
+    return mcxent(labels, preout, activation, mask)
+
+
+def kl_divergence(labels, preout, activation="softmax", mask=None):
+    out = jnp.clip(_activate(preout, activation), _EPS, 1.0)
+    lab = jnp.clip(labels, _EPS, 1.0)
+    per = jnp.sum(lab * (jnp.log(lab) - jnp.log(out)), axis=-1)
+    return _apply_mask(per, mask)
+
+
+def poisson(labels, preout, activation="identity", mask=None):
+    out = jnp.clip(_activate(preout, activation), _EPS, None)
+    per = jnp.sum(out - labels * jnp.log(out), axis=-1)
+    return _apply_mask(per, mask)
+
+
+def cosine_proximity(labels, preout, activation="identity", mask=None):
+    out = _activate(preout, activation)
+    num = jnp.sum(labels * out, axis=-1)
+    den = jnp.linalg.norm(labels, axis=-1) * jnp.linalg.norm(out, axis=-1) + _EPS
+    return _apply_mask(-num / den, mask)
+
+
+def hinge(labels, preout, activation="identity", mask=None):
+    out = _activate(preout, activation)
+    per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * out), axis=-1)
+    return _apply_mask(per, mask)
+
+
+def squared_hinge(labels, preout, activation="identity", mask=None):
+    out = _activate(preout, activation)
+    per = jnp.sum(jnp.maximum(0.0, 1.0 - labels * out) ** 2, axis=-1)
+    return _apply_mask(per, mask)
+
+
+def _apply_mask(per_example, mask):
+    if mask is None:
+        return per_example
+    return per_example * mask
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "mse": mse,
+    "l1": l1,
+    "l2": l2,
+    "xent": xent,
+    "mcxent": mcxent,
+    "negativeloglikelihood": negativeloglikelihood,
+    "kl_divergence": kl_divergence,
+    "reconstruction_crossentropy": xent,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+}
+
+
+def get(name: str) -> Callable:
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(_REGISTRY)}")
+
+
+def score(name, labels, preout, activation, mask=None, mean=True):
+    per = get(name)(labels, preout, activation, mask)
+    if per.ndim > 1:  # time series [batch, time] -> sum over time
+        per = jnp.sum(per, axis=tuple(range(1, per.ndim)))
+    if not mean:
+        return per
+    if mask is not None:
+        # masked mean: normalize by the number of unmasked timesteps
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.sum(per) / denom
+    return jnp.mean(per)

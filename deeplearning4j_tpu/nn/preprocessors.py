@@ -1,0 +1,170 @@
+"""Input preprocessors — shape adapters auto-inserted between layers.
+
+Reference: ``nn/conf/preprocessor/*.java`` (12 classes: CnnToFeedForward,
+FeedForwardToCnn, FeedForwardToRnn, RnnToFeedForward, RnnToCnn, CnnToRnn...)
+applied in ``MultiLayerNetwork.java:1139-1141`` forward and ``:1168-1170``
+backward.  Functional core: each is a pure reshape; the backward epsilon
+reshape the reference hand-writes comes free from autodiff.  Auto-insertion
+logic lives in the config build (``MultiLayerConfiguration`` here), replacing
+``ConvolutionLayerSetup.java:42``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Type
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+
+_PREPROC_REGISTRY: Dict[str, Type["Preprocessor"]] = {}
+
+
+def register_preproc(cls):
+    _PREPROC_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def preproc_from_dict(d):
+    d = dict(d)
+    cls = _PREPROC_REGISTRY[d.pop("type")]
+    return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Preprocessor:
+    def __call__(self, x):
+        raise NotImplementedError
+
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["type"] = type(self).__name__
+        return d
+
+
+@register_preproc
+@dataclasses.dataclass(frozen=True)
+class CnnToFeedForward(Preprocessor):
+    """[B,H,W,C] -> [B, H*W*C]."""
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.feed_forward(t.flat_size())
+
+
+@register_preproc
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToCnn(Preprocessor):
+    """[B, H*W*C] -> [B,H,W,C]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preproc
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToRnn(Preprocessor):
+    """[B*T, F] <- can't know T statically; here: [B, F] -> [B, 1, F] or pass
+    through 3D. Used when stacking dense under recurrent layers."""
+
+    def __call__(self, x):
+        return x if x.ndim == 3 else x[:, None, :]
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.recurrent(t.flat_size(), t.timesteps)
+
+
+@register_preproc
+@dataclasses.dataclass(frozen=True)
+class RnnToFeedForward(Preprocessor):
+    """[B,T,F] -> [B*T, F] (reference RnnToFeedForwardPreProcessor)."""
+
+    def __call__(self, x):
+        return x.reshape(-1, x.shape[-1])
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.feed_forward(t.size)
+
+
+@register_preproc
+@dataclasses.dataclass(frozen=True)
+class CnnToRnn(Preprocessor):
+    """[B,H,W,C] -> [B, 1, H*W*C]."""
+
+    def __call__(self, x):
+        return x.reshape(x.shape[0], 1, -1)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.recurrent(t.flat_size(), 1)
+
+
+@register_preproc
+@dataclasses.dataclass(frozen=True)
+class RnnToCnn(Preprocessor):
+    """[B,T,H*W*C] -> [B*T,H,W,C]."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def __call__(self, x):
+        return x.reshape(-1, self.height, self.width, self.channels)
+
+    def output_type(self, t: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+def auto_preprocessor(prev: InputType, layer) -> Optional[Preprocessor]:
+    """Pick the adapter between ``prev`` output type and what ``layer`` expects
+    (the ``InputTypeUtil``/``ConvolutionLayerSetup`` decision table)."""
+    from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
+    from deeplearning4j_tpu.nn.layers.dense import ActivationLayer, DropoutLayer
+    from deeplearning4j_tpu.nn.layers.normalization import (
+        BatchNormalization,
+        LocalResponseNormalization,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import (
+        GravesLSTM,
+        GravesBidirectionalLSTM,
+        RnnOutputLayer,
+    )
+
+    # shape-preserving layers consume whatever the previous layer produced
+    if isinstance(layer, (BatchNormalization, LocalResponseNormalization,
+                          ActivationLayer, DropoutLayer)):
+        return None
+
+    wants_cnn = isinstance(layer, (ConvolutionLayer, SubsamplingLayer))
+    wants_rnn = isinstance(layer, (GravesLSTM, GravesBidirectionalLSTM, RnnOutputLayer))
+
+    if wants_cnn:
+        if prev.kind == "cnn":
+            return None
+        if prev.kind in ("cnn_flat",):
+            return FeedForwardToCnn(prev.height, prev.width, prev.channels)
+        raise ValueError(f"Cannot feed {prev} into convolutional layer; use "
+                         f"InputType.convolutional_flat for image vectors")
+    if wants_rnn:
+        if prev.kind == "rnn":
+            return None
+        if prev.kind in ("ff", "cnn_flat"):
+            return FeedForwardToRnn()
+        if prev.kind == "cnn":
+            return CnnToRnn()
+    # feed-forward consumer
+    if prev.kind == "cnn":
+        return CnnToFeedForward()
+    return None

@@ -1,0 +1,80 @@
+"""Training-event listeners — the observability spine.
+
+Reference: ``optimize/api/IterationListener.java`` invoked from the SGD hot
+loop (``StochasticGradientDescent.java:65-66``); built-ins
+``ScoreIterationListener``, ``PerformanceListener`` (samples/sec :71-86),
+``CollectScoresIterationListener``, ``ComposableIterationListener``.
+Listeners run host-side between jitted steps, so they never break the XLA
+program; anything they read (score) is already on host.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int) -> None:
+        raise NotImplementedError
+
+
+class ScoreIterationListener(IterationListener):
+    def __init__(self, print_iterations: int = 10, log=None):
+        self.freq = max(1, print_iterations)
+        self.log = log or logger.info
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.freq == 0:
+            self.log(f"Score at iteration {iteration} is {model.score_value}")
+
+
+class PerformanceListener(IterationListener):
+    """Throughput: samples/sec, batches/sec, iteration wall time."""
+
+    def __init__(self, frequency: int = 1, report: Optional[Callable] = None):
+        self.freq = max(1, frequency)
+        self.report = report or logger.info
+        self._last_time: Optional[float] = None
+        self.last_samples_per_sec: Optional[float] = None
+        self.last_iteration_ms: Optional[float] = None
+        self._batch_size: Optional[int] = None
+
+    def set_batch_size(self, n: int):
+        self._batch_size = n
+
+    def iteration_done(self, model, iteration):
+        now = time.perf_counter()
+        if self._last_time is not None:
+            dt = now - self._last_time
+            self.last_iteration_ms = dt * 1e3
+            if self._batch_size:
+                self.last_samples_per_sec = self._batch_size / dt
+            if iteration % self.freq == 0:
+                msg = f"iteration {iteration}; iteration time: {self.last_iteration_ms:.2f} ms"
+                if self.last_samples_per_sec:
+                    msg += f"; samples/sec: {self.last_samples_per_sec:.2f}"
+                self.report(msg)
+        self._last_time = now
+
+
+class CollectScoresIterationListener(IterationListener):
+    def __init__(self, frequency: int = 1):
+        self.freq = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration):
+        if iteration % self.freq == 0:
+            self.scores.append((iteration, model.score_value))
+
+
+class ComposableIterationListener(IterationListener):
+    def __init__(self, *listeners):
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration):
+        for l in self.listeners:
+            l.iteration_done(model, iteration)

@@ -1,0 +1,31 @@
+"""Test harness: 8 virtual CPU devices so multi-chip sharding logic runs
+without TPU hardware (the reference's Spark local[N] pattern — SURVEY.md §4:
+'multi-node is simulated ... correctness of distribution is proven by
+equivalence to local sequential math').
+
+Note: jax may already be imported by the interpreter's sitecustomize (TPU
+tunnel registration), so platform selection must go through
+``jax.config.update`` (still effective pre-backend-init), not env vars.
+"""
+
+import os
+
+# Read by the CPU client at first backend init (lazy), so setting it here
+# works even if jax itself is already imported.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# float64 available for gradient-check precision (tests opt in per-array)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(12345)

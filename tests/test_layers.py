@@ -1,0 +1,204 @@
+"""Layer unit tests: shapes, forward semantics, config round-trip.
+Mirrors reference suites under deeplearning4j-core/src/test/.../nn/**."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import activations, initializers, losses
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    AutoEncoder,
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    EmbeddingLayer,
+    GravesBidirectionalLSTM,
+    GravesLSTM,
+    LocalResponseNormalization,
+    OutputLayer,
+    RBM,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.base import layer_from_dict
+
+
+KEY = jax.random.key(0)
+
+
+def test_dense_forward_shape():
+    layer = DenseLayer(n_in=4, n_out=3, activation="relu", name="d0")
+    p = layer.init(KEY)
+    assert p["W"].shape == (4, 3) and p["b"].shape == (3,)
+    y, _ = layer.apply(p, {}, jnp.ones((2, 4)))
+    assert y.shape == (2, 3)
+    # relu of positive preactivation matches manual matmul
+    expected = jax.nn.relu(jnp.ones((2, 4)) @ p["W"] + p["b"])
+    np.testing.assert_allclose(y, expected, rtol=1e-6)
+
+
+def test_dense_setup_infers_n_in():
+    layer = DenseLayer(n_out=7).setup(InputType.feed_forward(13))
+    assert layer.n_in == 13
+    assert layer.output_type(InputType.feed_forward(13)).size == 7
+
+
+def test_conv_shapes():
+    layer = ConvolutionLayer(n_out=6, kernel_size=(5, 5), stride=(1, 1),
+                             name="c").setup(InputType.convolutional(28, 28, 1))
+    assert layer.n_in == 1
+    out = layer.output_type(InputType.convolutional(28, 28, 1))
+    assert (out.height, out.width, out.channels) == (24, 24, 6)
+    p = layer.init(KEY)
+    y, _ = layer.apply(p, {}, jnp.ones((2, 28, 28, 1)))
+    assert y.shape == (2, 24, 24, 6)
+
+
+def test_subsampling_max_pool():
+    layer = SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2))
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = layer.apply({}, {}, x)
+    assert y.shape == (1, 2, 2, 1)
+    np.testing.assert_allclose(y[0, :, :, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_subsampling_avg_pool():
+    layer = SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2), stride=(2, 2))
+    x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+    y, _ = layer.apply({}, {}, x)
+    np.testing.assert_allclose(y[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batchnorm_train_and_inference():
+    layer = BatchNormalization(n_out=3, decay=0.5, name="bn")
+    p = layer.init(KEY)
+    st = layer.init_state()
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 3) * 3 + 1, jnp.float32)
+    y, new_st = layer.apply(p, st, x, train=True)
+    # normalized output: ~zero mean, ~unit var
+    np.testing.assert_allclose(np.mean(np.asarray(y), 0), 0, atol=1e-5)
+    np.testing.assert_allclose(np.var(np.asarray(y), 0), 1, atol=1e-2)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(new_st["mean"]), 0)
+    # inference path uses running stats, state unchanged
+    y2, st2 = layer.apply(p, new_st, x, train=False)
+    assert st2 is new_st
+
+
+def test_batchnorm_conv_rank4():
+    layer = BatchNormalization(n_out=2)
+    p, st = layer.init(KEY), layer.init_state()
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 5, 5, 2), jnp.float32)
+    y, _ = layer.apply(p, st, x, train=True)
+    assert y.shape == x.shape
+    np.testing.assert_allclose(np.asarray(y).mean((0, 1, 2)), 0, atol=1e-5)
+
+
+def test_lrn_shape_and_identity_limit():
+    layer = LocalResponseNormalization()
+    x = jnp.ones((2, 4, 4, 8))
+    y, _ = layer.apply({}, {}, x)
+    assert y.shape == x.shape
+    assert float(y[0, 0, 0, 4]) < 1.0  # denominator > 1
+
+
+def test_embedding_lookup():
+    layer = EmbeddingLayer(n_in=10, n_out=4, name="e")
+    p = layer.init(KEY)
+    idx = jnp.asarray([[1], [3]])
+    y, _ = layer.apply(p, {}, idx)
+    assert y.shape == (2, 4)
+    np.testing.assert_allclose(y[0], p["W"][1] + p["b"], rtol=1e-6)
+
+
+def test_dropout_train_vs_test():
+    layer = DropoutLayer(dropout=0.5)
+    x = jnp.ones((4, 10))
+    y_test, _ = layer.apply({}, {}, x, train=False)
+    np.testing.assert_allclose(y_test, x)
+    y_train, _ = layer.apply({}, {}, x, train=True, rng=jax.random.key(1))
+    vals = np.unique(np.asarray(y_train))
+    assert set(np.round(vals, 4)).issubset({0.0, 2.0})
+
+
+def test_lstm_shapes_and_streaming_consistency():
+    layer = GravesLSTM(n_in=3, n_out=5, name="l")
+    p = layer.init(KEY)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 7, 3), jnp.float32)
+    y, _ = layer.apply(p, {}, x)
+    assert y.shape == (2, 7, 5)
+    # streaming step-by-step equals full-sequence scan
+    carry = layer.initial_carry(2, x.dtype)
+    outs = []
+    for t in range(7):
+        o, carry = layer.step(p, carry, x[:, t])
+        outs.append(o)
+    np.testing.assert_allclose(np.stack(outs, 1), np.asarray(y), rtol=2e-5, atol=1e-5)
+
+
+def test_lstm_masking_freezes_state():
+    layer = GravesLSTM(n_in=3, n_out=4)
+    p = layer.init(KEY)
+    x = jnp.asarray(np.random.RandomState(1).randn(1, 5, 3), jnp.float32)
+    mask = jnp.asarray([[1.0, 1.0, 1.0, 0.0, 0.0]])
+    y, _, (hT, cT) = layer.apply_with_carry(p, {}, x, None, mask=mask)
+    # masked outputs are zero
+    np.testing.assert_allclose(np.asarray(y[0, 3:]), 0, atol=1e-7)
+    # final carry equals carry after step 3
+    y3, _, (h3, c3) = layer.apply_with_carry(p, {}, x[:, :3], None)
+    np.testing.assert_allclose(np.asarray(hT), np.asarray(h3), rtol=1e-5)
+
+
+def test_bidirectional_lstm_sums_directions():
+    layer = GravesBidirectionalLSTM(n_in=3, n_out=4, name="b")
+    p = layer.init(KEY)
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 6, 3), jnp.float32)
+    y, _ = layer.apply(p, {}, x)
+    assert y.shape == (2, 6, 4)
+
+
+def test_autoencoder_pretrain_loss_decreases():
+    layer = AutoEncoder(n_in=8, n_out=4, corruption_level=0.0, name="ae",
+                        activation="sigmoid")
+    p = layer.init(KEY)
+    x = jnp.asarray(np.random.RandomState(3).rand(32, 8), jnp.float32)
+    loss_fn = jax.jit(jax.value_and_grad(lambda pp: layer.pretrain_loss(pp, x, jax.random.key(0))))
+    l0, _ = loss_fn(p)
+    for _ in range(50):
+        l, g = loss_fn(p)
+        p = jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g)
+    assert float(l) < float(l0)
+
+
+def test_rbm_cd_reduces_reconstruction_error():
+    layer = RBM(n_in=6, n_out=4, k=1, name="rbm")
+    p = layer.init(KEY)
+    rs = np.random.RandomState(4)
+    x = jnp.asarray((rs.rand(64, 6) > 0.5).astype(np.float32))
+    loss_fn = jax.jit(jax.value_and_grad(layer.pretrain_loss))
+    err0 = float(layer.reconstruction_error(p, x, jax.random.key(0)))
+    key = jax.random.key(1)
+    for i in range(100):
+        key, sub = jax.random.split(key)
+        _, g = loss_fn(p, x, sub)
+        p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+    err1 = float(layer.reconstruction_error(p, x, jax.random.key(0)))
+    assert err1 < err0
+
+
+def test_layer_json_roundtrip():
+    for layer in [
+        DenseLayer(n_in=3, n_out=4, activation="relu", l2=0.01, name="x"),
+        ConvolutionLayer(n_in=1, n_out=6, kernel_size=(3, 3), name="c"),
+        SubsamplingLayer(pooling_type="avg"),
+        BatchNormalization(n_out=5),
+        GravesLSTM(n_in=2, n_out=3),
+        OutputLayer(n_in=4, n_out=2, loss="mcxent", activation="softmax"),
+        RBM(n_in=3, n_out=2),
+    ]:
+        d = layer.to_dict()
+        restored = layer_from_dict(d)
+        assert restored == layer, f"round-trip failed for {type(layer).__name__}"

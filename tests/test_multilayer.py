@@ -1,0 +1,212 @@
+"""MultiLayerNetwork facade tests (reference MultiLayerTest / conf serde suites)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+
+def simple_net(updater="sgd", lr=0.5, seed=42):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater, learning_rate=lr)
+        .list()
+        .layer(DenseLayer(n_in=2, n_out=8, activation="tanh", weight_init="xavier"))
+        .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent", activation="softmax"))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def xor_data():
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+    y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+    return x, y
+
+
+def test_fit_learns_xor():
+    net = simple_net(lr=1.0)
+    x, y = xor_data()
+    s0 = net.score(x, y)
+    for _ in range(300):
+        net.fit(x, y)
+    assert net.score(x, y) < s0 * 0.2
+    preds = np.asarray(net.output(x))
+    assert (preds.argmax(-1) == y.argmax(-1)).all()
+
+
+def test_listeners_receive_scores():
+    net = simple_net()
+    col = CollectScoresIterationListener()
+    net.set_listeners(col)
+    x, y = xor_data()
+    for _ in range(5):
+        net.fit(x, y)
+    assert len(col.scores) == 5
+    assert all(np.isfinite(s) for _, s in col.scores)
+
+
+def test_config_json_roundtrip_full_network():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater("adam", learning_rate=1e-3)
+        .regularization(True)
+        .l2(1e-4)
+        .list()
+        .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5), activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(BatchNormalization())
+        .layer(DenseLayer(n_out=32, activation="relu"))
+        .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+    js = conf.to_json()
+    restored = MultiLayerConfiguration.from_json(js)
+    assert restored == conf
+    # and it initializes identically
+    n1 = MultiLayerNetwork(conf).init()
+    n2 = MultiLayerNetwork(restored).init()
+    for l1, l2 in zip(
+        jax.tree_util.tree_leaves(n1.params), jax.tree_util.tree_leaves(n2.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+def test_input_type_inference_lenet_shapes():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), activation="relu"))
+        .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=10, loss="mcxent", activation="softmax"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build()
+    )
+    # conv1 sees 1 channel; dense sees 4*4*50 = 800
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[2].n_in == 20
+    assert conf.layers[4].n_in == 4 * 4 * 50
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.RandomState(0).rand(2, 784).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    net = simple_net(updater="adam", lr=0.01)
+    x, y = xor_data()
+    for _ in range(10):
+        net.fit(x, y)
+    path = tmp_path / "model.zip"
+    net.save(path)
+    restored = MultiLayerNetwork.load(path)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(restored.output(x)), rtol=1e-6
+    )
+    assert restored.iteration == net.iteration
+    # resume training continues identically (updater state restored)
+    net.fit(x, y)
+    restored.fit(x, y)
+    np.testing.assert_allclose(
+        net.params_to_vector(), restored.params_to_vector(), rtol=1e-5
+    )
+
+
+def test_params_vector_roundtrip():
+    net = simple_net()
+    vec = net.params_to_vector()
+    assert vec.size == net.num_params()
+    net2 = simple_net(seed=99)
+    net2.set_params_vector(vec)
+    np.testing.assert_array_equal(net2.params_to_vector(), vec)
+
+
+def test_rnn_fit_and_time_step():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .updater("adam", learning_rate=0.01)
+        .list()
+        .layer(GravesLSTM(n_in=4, n_out=8, activation="tanh"))
+        .layer(RnnOutputLayer(n_in=8, n_out=4, loss="mcxent", activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    x = rs.rand(3, 6, 4).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, (3, 6))]
+    s0 = net.score(x, y)
+    for _ in range(30):
+        net.fit(x, y)
+    assert net.score(x, y) < s0
+    # streaming: rnn_time_step over the sequence == full output
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    outs = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(6)]
+    np.testing.assert_allclose(np.stack(outs, 1), full, rtol=2e-4, atol=1e-5)
+
+
+def test_tbptt_training_runs():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .updater("sgd", learning_rate=0.1)
+        .list()
+        .layer(GravesLSTM(n_in=3, n_out=6))
+        .layer(RnnOutputLayer(n_in=6, n_out=3, loss="mcxent", activation="softmax"))
+        .backprop_type("truncated_bptt", fwd_length=4, back_length=4)
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    x = rs.rand(2, 12, 3).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, (2, 12))]
+    s0 = net.score(x, y)
+    for _ in range(20):
+        net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    assert net.score(x, y) < s0
+    # 12 timesteps / fwd 4 = 3 steps per fit call
+    assert net.iteration == 20 * 3
+
+
+def test_per_layer_lr_override():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .updater("sgd", learning_rate=0.0)  # global lr zero
+        .list()
+        .layer(DenseLayer(n_in=2, n_out=4, activation="tanh", learning_rate=0.5))
+        .layer(OutputLayer(n_in=4, n_out=2, loss="mcxent", activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init()
+    x, y = xor_data()
+    w_out_before = np.asarray(net.params["layer_1"]["W"]).copy()
+    w_hid_before = np.asarray(net.params["layer_0"]["W"]).copy()
+    net.fit(x, y)
+    # output layer frozen (lr 0), hidden layer moved (lr 0.5)
+    np.testing.assert_array_equal(np.asarray(net.params["layer_1"]["W"]), w_out_before)
+    assert not np.allclose(np.asarray(net.params["layer_0"]["W"]), w_hid_before)
