@@ -1,0 +1,71 @@
+"""Benchmark entry — LeNet-MNIST train-step time on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference stack is DL4J/ND4J on CPU BLAS (it publishes no
+numbers — BASELINE.md); a reference-class CPU measurement (torch-CPU LeNet,
+batch 128, single-thread BLAS, measured in this image: 62.45 ms/step) stands
+in as the comparison point.  vs_baseline = baseline_ms / our_ms (>1 = faster
+than reference-class CPU).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+REFERENCE_CPU_STEP_MS = 62.45  # torch-CPU LeNet b128 step, this image (see docstring)
+BATCH = 128
+WARMUP = 5
+ITERS = 50
+
+
+def main():
+    import jax
+
+    from deeplearning4j_tpu.models.zoo import lenet
+    from deeplearning4j_tpu.datasets.mnist import MnistDataFetcher
+
+    net = lenet(updater="nesterovs", lr=0.01)
+    fetcher = MnistDataFetcher(train=True, num_examples=BATCH * 4)
+    ds = fetcher.dataset()
+    x = ds.features[:BATCH]
+    y = ds.labels[:BATCH]
+
+    step = net._get_train_step()
+    import jax.numpy as jnp
+
+    params, upd_state, net_state = net.params, net.updater_state, net.net_state
+    xj, yj = jnp.asarray(x), jnp.asarray(y)
+
+    def one(it):
+        nonlocal params, upd_state, net_state
+        params, upd_state, net_state, loss, _ = step(
+            params, upd_state, net_state, jnp.asarray(float(it)), xj, yj,
+            net._keys.next(), None, None, None,
+        )
+        return loss
+
+    for i in range(WARMUP):
+        loss = one(i)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(ITERS):
+        loss = one(WARMUP + i)
+    jax.block_until_ready(loss)
+    dt_ms = (time.perf_counter() - t0) / ITERS * 1e3
+
+    result = {
+        "metric": "LeNet-MNIST train step time (batch 128)",
+        "value": round(dt_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(REFERENCE_CPU_STEP_MS / dt_ms, 2),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

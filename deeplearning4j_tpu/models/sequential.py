@@ -84,13 +84,14 @@ class MultiLayerNetwork:
 
     def set_params_vector(self, vec: np.ndarray) -> None:
         leaves, treedef = jax.tree_util.tree_flatten(self.params)
+        total = sum(int(np.prod(l.shape)) for l in leaves)
+        if total != vec.size:
+            raise ValueError(f"param vector size {vec.size} != model size {total}")
         out, off = [], 0
         for l in leaves:
             n = int(np.prod(l.shape))
             out.append(jnp.asarray(vec[off : off + n], l.dtype).reshape(l.shape))
             off += n
-        if off != vec.size:
-            raise ValueError(f"param vector size {vec.size} != model size {off}")
         self.params = jax.tree_util.tree_unflatten(treedef, out)
 
     # --------------------------------------------------------------- forward
@@ -125,8 +126,11 @@ class MultiLayerNetwork:
                 h = layer.maybe_dropout(h, train=train, rng=rngs[i])
                 h = layer.pre_output(params[layer.name], h)
             else:
+                from deeplearning4j_tpu.nn.layers.convolution import GlobalPoolingLayer
+
+                kw = {"mask": fmask} if isinstance(layer, GlobalPoolingLayer) else {}
                 h, lst = layer.apply(params[layer.name], lstate, h,
-                                     train=train, rng=rngs[i])
+                                     train=train, rng=rngs[i], **kw)
                 if lst:
                     new_state[layer.name] = lst
             if collect:

@@ -1,0 +1,195 @@
+"""Graph vertices — DAG building blocks for ComputationGraph.
+
+Reference: ``nn/graph/vertex/GraphVertex.java:36,113,119`` (doForward/
+doBackward SPI) and impls ``nn/graph/vertex/impl/{Layer,ElementWise,Merge,
+Subset,Preprocessor,Input}Vertex.java`` + ``impl/rnn/{LastTimeStep,
+DuplicateToTimeSeries}Vertex.java``.  Functional redesign: a vertex is a
+pure function of its input activations; ``doBackward`` is autodiff.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+
+_VERTEX_REGISTRY: Dict[str, Type["GraphVertex"]] = {}
+
+
+def register_vertex(cls):
+    _VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def vertex_from_dict(d: Dict[str, Any]) -> "GraphVertex":
+    d = dict(d)
+    cls = _VERTEX_REGISTRY[d.pop("type")]
+    return cls.from_dict(d)
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphVertex:
+    def apply(self, inputs: List[jax.Array]) -> jax.Array:
+        raise NotImplementedError
+
+    def output_type(self, input_types: List[InputType]) -> InputType:
+        raise NotImplementedError
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["type"] = type(self).__name__
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class ElementWiseVertex(GraphVertex):
+    """Pointwise combine: add | subtract | product | average | max
+    (reference ``ElementWiseVertex.java``; 'add' is the residual-connection
+    vertex ResNet uses)."""
+
+    op: str = "add"
+
+    def apply(self, inputs):
+        op = self.op.lower()
+        if op == "add":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op == "subtract":
+            return inputs[0] - inputs[1]
+        if op in ("product", "mul"):
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op in ("average", "avg"):
+            return sum(inputs) / len(inputs)
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"Unknown elementwise op {self.op}")
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature/channel axis (reference
+    ``MergeVertex.java``; inception-style blocks)."""
+
+    def apply(self, inputs):
+        return jnp.concatenate(inputs, axis=-1)
+
+    def output_type(self, input_types):
+        t0 = input_types[0]
+        if t0.kind == "cnn":
+            return InputType.convolutional(
+                t0.height, t0.width, sum(t.channels for t in input_types)
+            )
+        if t0.kind == "rnn":
+            return InputType.recurrent(sum(t.size for t in input_types), t0.timesteps)
+        return InputType.feed_forward(sum(t.flat_size() for t in input_types))
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive (reference ``SubsetVertex``)."""
+
+    index_from: int = 0
+    index_to: int = 0
+
+    def apply(self, inputs):
+        return inputs[0][..., self.index_from : self.index_to + 1]
+
+    def output_type(self, input_types):
+        n = self.index_to - self.index_from + 1
+        t = input_types[0]
+        if t.kind == "rnn":
+            return InputType.recurrent(n, t.timesteps)
+        if t.kind == "cnn":
+            return InputType.convolutional(t.height, t.width, n)
+        return InputType.feed_forward(n)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class ScaleVertex(GraphVertex):
+    factor: float = 1.0
+
+    def apply(self, inputs):
+        return inputs[0] * self.factor
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class LastTimeStepVertex(GraphVertex):
+    """[B,T,F] -> [B,F] at the last unmasked step (reference
+    ``rnn/LastTimeStepVertex.java``).  With a mask, picks each example's
+    final real timestep via one gather."""
+
+    def apply(self, inputs, mask=None):
+        x = inputs[0]
+        if mask is not None:
+            idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+            return jax.vmap(lambda seq, i: seq[i])(x, idx)
+        return x[:, -1]
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B,F] -> [B,T,F] broadcast over T taken from a reference input
+    (reference ``rnn/DuplicateToTimeSeriesVertex.java``)."""
+
+    timesteps: Optional[int] = None
+
+    def apply(self, inputs):
+        x = inputs[0]
+        T = self.timesteps
+        if T is None and len(inputs) > 1:
+            T = inputs[1].shape[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], T, x.shape[-1]))
+
+    def output_type(self, input_types):
+        return InputType.recurrent(input_types[0].flat_size(), self.timesteps)
+
+
+@register_vertex
+@dataclasses.dataclass(frozen=True)
+class PreprocessorVertex(GraphVertex):
+    """Wraps an input preprocessor as a standalone vertex."""
+
+    preprocessor: Optional[dict] = None  # serialized Preprocessor
+
+    def _proc(self):
+        from deeplearning4j_tpu.nn.preprocessors import preproc_from_dict
+
+        return preproc_from_dict(self.preprocessor)
+
+    def apply(self, inputs):
+        return self._proc()(inputs[0])
+
+    def output_type(self, input_types):
+        return self._proc().output_type(input_types[0])

@@ -1,0 +1,151 @@
+"""Model zoo — the benchmark configs from BASELINE.md built on the DSL.
+
+- LeNet-5 / MNIST  (reference baseline config 1: MultiLayerNetwork)
+- ResNet-50        (reference baseline config 2: ComputationGraph; residual
+  adds via ElementWiseVertex)
+- GravesLSTM char-LM (reference baseline config 3)
+
+All TPU-first: NHWC, bf16-ready, static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization,
+    ConvolutionLayer,
+    DenseLayer,
+    GlobalPoolingLayer,
+    GravesLSTM,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.models.graph import ComputationGraph, GraphConfiguration
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.models.vertices import ElementWiseVertex
+
+
+def lenet(seed: int = 12345, updater: str = "nesterovs", lr: float = 0.01,
+          n_classes: int = 10) -> MultiLayerNetwork:
+    """LeNet-5 on 28x28x1 (the classic DL4J MNIST example config)."""
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater, learning_rate=lr)
+        .regularization(True)
+        .l2(5e-4)
+        .list()
+        .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                activation="identity", weight_init="xavier"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                activation="identity"))
+        .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+        .layer(DenseLayer(n_out=500, activation="relu"))
+        .layer(OutputLayer(n_out=n_classes, loss="mcxent", activation="softmax"))
+        .set_input_type(InputType.convolutional_flat(28, 28, 1))
+        .build()
+    )
+    return MultiLayerNetwork(conf).init()
+
+
+def _bottleneck(g, name: str, in_name: str, channels: int, stride: int,
+                project: bool):
+    """ResNet-v1 bottleneck: 1x1 -> 3x3 -> 1x1(4c) + shortcut, post-add relu."""
+    mid = channels
+    out_ch = channels * 4
+    g.add_layer(f"{name}_c1", ConvolutionLayer(
+        n_out=mid, kernel_size=(1, 1), stride=(stride, stride),
+        activation="identity", weight_init="relu"), in_name)
+    g.add_layer(f"{name}_bn1", BatchNormalization(activation="relu"), f"{name}_c1")
+    g.add_layer(f"{name}_c2", ConvolutionLayer(
+        n_out=mid, kernel_size=(3, 3), stride=(1, 1), padding=(1, 1),
+        activation="identity", weight_init="relu"), f"{name}_bn1")
+    g.add_layer(f"{name}_bn2", BatchNormalization(activation="relu"), f"{name}_c2")
+    g.add_layer(f"{name}_c3", ConvolutionLayer(
+        n_out=out_ch, kernel_size=(1, 1), stride=(1, 1),
+        activation="identity", weight_init="relu"), f"{name}_bn2")
+    g.add_layer(f"{name}_bn3", BatchNormalization(activation="identity"), f"{name}_c3")
+    shortcut = in_name
+    if project:
+        g.add_layer(f"{name}_proj", ConvolutionLayer(
+            n_out=out_ch, kernel_size=(1, 1), stride=(stride, stride),
+            activation="identity", weight_init="relu"), in_name)
+        g.add_layer(f"{name}_projbn", BatchNormalization(activation="identity"),
+                    f"{name}_proj")
+        shortcut = f"{name}_projbn"
+    g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), f"{name}_bn3", shortcut)
+    from deeplearning4j_tpu.nn.layers import ActivationLayer
+
+    g.add_layer(f"{name}_relu", ActivationLayer(activation="relu"), f"{name}_add")
+    return f"{name}_relu"
+
+
+def resnet50(height: int = 224, width: int = 224, channels: int = 3,
+             n_classes: int = 1000, seed: int = 12345,
+             updater: str = "nesterovs", lr: float = 0.1,
+             blocks: Sequence[int] = (3, 4, 6, 3),
+             stem_stride: int = 2, init_channels: int = 64) -> ComputationGraph:
+    """ResNet-50 as a ComputationGraph (residual adds = ElementWiseVertex,
+    the reference's DAG capability exercised at benchmark scale).
+
+    For CIFAR-scale inputs pass height=width=32, stem_stride=1."""
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater, learning_rate=lr)
+        .graph()
+        .add_inputs("input")
+        .set_input_types(input=InputType.convolutional(height, width, channels))
+    )
+    stem_kernel = (7, 7) if stem_stride == 2 else (3, 3)
+    stem_pad = (3, 3) if stem_stride == 2 else (1, 1)
+    b.add_layer("stem", ConvolutionLayer(
+        n_out=init_channels, kernel_size=stem_kernel,
+        stride=(stem_stride, stem_stride), padding=stem_pad,
+        activation="identity", weight_init="relu"), "input")
+    b.add_layer("stem_bn", BatchNormalization(activation="relu"), "stem")
+    prev = "stem_bn"
+    if stem_stride == 2:
+        b.add_layer("stem_pool", SubsamplingLayer(
+            pooling_type="max", kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)),
+            "stem_bn")
+        prev = "stem_pool"
+    ch = init_channels
+    for stage, n_blocks in enumerate(blocks):
+        for i in range(n_blocks):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            project = i == 0
+            prev = _bottleneck(b, f"s{stage}b{i}", prev, ch, stride, project)
+        ch *= 2
+    b.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), prev)
+    b.add_layer("fc", OutputLayer(n_out=n_classes, loss="mcxent",
+                                  activation="softmax", weight_init="xavier"), "gap")
+    conf = b.set_outputs("fc").build()
+    return ComputationGraph(conf).init()
+
+
+def graves_lstm_char_lm(vocab_size: int = 77, hidden: int = 200,
+                        seq_len: int = 64, layers: int = 2,
+                        seed: int = 12345, updater: str = "rmsprop",
+                        lr: float = 0.1, tbptt: int = 50) -> MultiLayerNetwork:
+    """GravesLSTM character language model (the classic DL4J char-RNN
+    example shape; reference recurrent benchmark config)."""
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater, learning_rate=lr)
+        .list()
+    )
+    n_in = vocab_size
+    for i in range(layers):
+        b.layer(GravesLSTM(n_in=n_in, n_out=hidden, activation="tanh"))
+        n_in = hidden
+    b.layer(RnnOutputLayer(n_in=hidden, n_out=vocab_size, loss="mcxent",
+                           activation="softmax"))
+    conf = b.backprop_type("truncated_bptt", fwd_length=tbptt, back_length=tbptt).build()
+    return MultiLayerNetwork(conf).init()

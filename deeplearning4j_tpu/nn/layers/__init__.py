@@ -6,7 +6,11 @@ from deeplearning4j_tpu.nn.layers.dense import (
     DropoutLayer,
     EmbeddingLayer,
 )
-from deeplearning4j_tpu.nn.layers.convolution import ConvolutionLayer, SubsamplingLayer
+from deeplearning4j_tpu.nn.layers.convolution import (
+    ConvolutionLayer,
+    SubsamplingLayer,
+    GlobalPoolingLayer,
+)
 from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization,
     LocalResponseNormalization,

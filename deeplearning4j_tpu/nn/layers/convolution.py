@@ -149,3 +149,45 @@ class SubsamplingLayer(Layer):
         else:
             raise ValueError(f"Unknown pooling type {self.pooling_type}")
         return y, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GlobalPoolingLayer(Layer):
+    """Global spatial (or temporal) pooling: [B,H,W,C]->[B,C] or
+    [B,T,F]->[B,F].  TPU-native reduction; used by ResNet-style heads."""
+
+    pooling_type: str = "avg"  # avg | max | sum
+
+    def has_params(self) -> bool:
+        return False
+
+    def init(self, key, dtype=jnp.float32):
+        return {}
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        return InputType.feed_forward(input_type.size)
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        pt = self.pooling_type.lower()
+        if mask is not None and x.ndim == 3:
+            # masked temporal pooling: exclude padded timesteps
+            m = mask[..., None]
+            if pt in ("avg", "mean"):
+                denom = jnp.maximum(jnp.sum(m, axis=1), 1.0)
+                return jnp.sum(x * m, axis=1) / denom, state
+            if pt == "max":
+                neg = jnp.asarray(-jnp.inf, x.dtype)
+                return jnp.max(jnp.where(m > 0, x, neg), axis=1), state
+            if pt == "sum":
+                return jnp.sum(x * m, axis=1), state
+        if pt in ("avg", "mean"):
+            return jnp.mean(x, axis=axes), state
+        if pt == "max":
+            return jnp.max(x, axis=axes), state
+        if pt == "sum":
+            return jnp.sum(x, axis=axes), state
+        raise ValueError(f"Unknown pooling type {self.pooling_type}")

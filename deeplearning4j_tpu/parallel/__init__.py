@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.training_master import (
+    TrainingMaster,
+    SyncTrainingMaster,
+    ParameterAveragingTrainingMaster,
+    DistributedNetwork,
+)

@@ -1,0 +1,208 @@
+"""Single-host data parallelism — the ParallelWrapper redesign.
+
+Reference: ``deeplearning4j-core/.../parallelism/ParallelWrapper.java:37-205``:
+N Java threads each own a model replica pinned to a device; a round-robin
+queue feeds them; every ``averagingFrequency`` iterations params are averaged
+via ``Nd4j.averageAndPropagate`` (and optionally updater state too).
+
+TPU-native redesign: no threads, no queues, no host-side averaging.  The K
+replicas are ONE jitted program over a ``Mesh``:
+
+- replica params are a stacked pytree (leading axis K) sharded over the
+  'data' mesh axis — each device holds exactly its replica;
+- the per-replica train step is ``jax.vmap`` of the single-model step, so
+  the whole "N workers train independently" phase is a single XLA program
+  with zero communication;
+- parameter averaging is ``mean over the replica axis`` — XLA lowers it to
+  an all-reduce that rides ICI (replacing averageAndPropagate), followed by
+  re-broadcast.  Updater-state averaging is the same tree-map, gated by
+  ``average_updaters`` exactly like the reference.
+
+``averaging_frequency=1`` + SGD reproduces synchronous DP; higher
+frequencies reproduce the reference's looser local-SGD semantics bit-for-bit
+(see tests/test_parallel.py equivalence tests).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.optimize import updaters as upd
+
+
+def _stack_tree(tree, k: int):
+    return jax.tree_util.tree_map(lambda a: jnp.broadcast_to(a[None], (k,) + a.shape), tree)
+
+
+class ParallelWrapper:
+    """Data-parallel trainer over the local mesh.
+
+    Usage mirrors the reference builder:
+        pw = ParallelWrapper(net, workers=8, prefetch_size=2,
+                             averaging_frequency=3, average_updaters=True)
+        pw.fit(iterator)
+    """
+
+    def __init__(
+        self,
+        net,
+        workers: Optional[int] = None,
+        prefetch_size: int = 2,
+        averaging_frequency: int = 1,
+        average_updaters: bool = True,
+        mesh: Optional[Mesh] = None,
+    ):
+        self.net = net
+        self.mesh = mesh or backend.default_mesh()
+        self.workers = workers or self.mesh.shape[backend.AXIS_DATA]
+        if self.workers != self.mesh.shape[backend.AXIS_DATA]:
+            raise ValueError(
+                f"workers={self.workers} must equal the mesh data-axis size "
+                f"{self.mesh.shape[backend.AXIS_DATA]}"
+            )
+        self.prefetch_size = prefetch_size
+        self.averaging_frequency = max(1, averaging_frequency)
+        self.average_updaters = average_updaters
+        self._step_fn = None
+        self.iteration = 0
+
+    # -- sharding specs ----------------------------------------------------
+    def _replica_sharding(self):
+        """Leading replica axis sharded over 'data'; inner dims replicated."""
+        return NamedSharding(self.mesh, P(backend.AXIS_DATA))
+
+    def _build(self):
+        net = self.net
+        cfg = net.conf.updater
+        lr_overrides = {
+            l.name: l.learning_rate for l in net.layers if l.learning_rate is not None
+        }
+        avg_freq = self.averaging_frequency
+        average_updaters = self.average_updaters
+
+        def one_replica_step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
+            (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
+                params, net_state, x, y, rng, fm, lm, None
+            )
+            grads = {k: v for k, v in grads.items() if v}
+            updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
+            new_params = dict(params)
+            for lname, u in updates.items():
+                new_params[lname] = {p: params[lname][p] - u[p] for p in u}
+            return new_params, new_us, new_ns, loss
+
+        vstep = jax.vmap(one_replica_step, in_axes=(0, 0, 0, None, 0, 0, 0, 0, 0))
+
+        def fit_window(params_k, upd_k, ns_k, iteration, xs, ys, rngs, fms, lms):
+            """avg_freq minibatches per replica, then average.
+            xs: [avg_freq, K, B, ...]"""
+
+            def body(carry, inp):
+                p, u, n, it = carry
+                x, y, rng, fm, lm = inp
+                p, u, n, loss = vstep(p, u, n, it, x, y, rng, fm, lm)
+                return (p, u, n, it + 1.0), loss
+
+            (params_k, upd_k, ns_k, _), losses = jax.lax.scan(
+                body, (params_k, upd_k, ns_k, iteration), (xs, ys, rngs, fms, lms)
+            )
+            # parameter averaging: all-reduce over the replica axis then
+            # re-broadcast (reference averageAndPropagate semantics)
+            params_k = jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(jnp.mean(a, 0, keepdims=True), a.shape), params_k
+            )
+            ns_k = jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(jnp.mean(a, 0, keepdims=True), a.shape), ns_k
+            )
+            if average_updaters:
+                upd_k = jax.tree_util.tree_map(
+                    lambda a: jnp.broadcast_to(jnp.mean(a, 0, keepdims=True), a.shape), upd_k
+                )
+            return params_k, upd_k, ns_k, losses
+
+        self._step_fn = jax.jit(fit_window, donate_argnums=(0, 1, 2))
+
+    # -- fit ---------------------------------------------------------------
+    def fit(self, iterator):
+        """Train over an iterator of DataSets.  Each averaging window
+        consumes ``workers * averaging_frequency`` minibatches (reference
+        split sizing ``ParameterAveragingTrainingMaster.java:315-321``)."""
+        from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
+
+        if isinstance(iterator, DataSetIterator) and iterator.async_supported():
+            iterator = AsyncDataSetIterator(iterator, self.prefetch_size)
+        if self._step_fn is None:
+            self._build()
+
+        net = self.net
+        K, F = self.workers, self.averaging_frequency
+        params_k = _stack_tree(net.params, K)
+        upd_k = _stack_tree(net.updater_state, K)
+        ns_k = _stack_tree(net.net_state, K)
+        shard = self._replica_sharding()
+        params_k = jax.device_put(params_k, shard)
+        upd_k = jax.device_put(upd_k, shard) if net.updater_state else upd_k
+        ns_k = jax.device_put(ns_k, shard) if net.net_state else ns_k
+
+        it = net.iteration
+        window: list = []
+        last_losses = None
+        for ds in iterator:
+            window.append(ds)
+            if len(window) == K * F:
+                params_k, upd_k, ns_k, last_losses = self._run_window(
+                    params_k, upd_k, ns_k, window, it
+                )
+                it += len(window) // K
+                window = []
+        # leftover minibatches train on a truncated window (pad replicas)
+        if window:
+            while len(window) % K:
+                window.append(window[-1])  # duplicate to fill replicas
+            params_k, upd_k, ns_k, last_losses = self._run_window(
+                params_k, upd_k, ns_k, window, it
+            )
+            it += len(window) // K
+
+        # fold averaged replica-0 state back into the facade
+        net.params = jax.tree_util.tree_map(lambda a: a[0], params_k)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a[0], upd_k)
+        net.net_state = jax.tree_util.tree_map(lambda a: a[0], ns_k)
+        if last_losses is not None:
+            net.score_value = float(np.asarray(last_losses)[-1].mean())
+        self.iteration = it - net.iteration
+        net.iteration = it
+        return net
+
+    def _run_window(self, params_k, upd_k, ns_k, window, iteration):
+        K = self.workers
+        F = len(window) // K
+        # equalize batch sizes across the window (short/ragged final batches)
+        max_b = max(len(w) for w in window)
+        window = [w.pad_batch(max_b) if len(w) < max_b else w for w in window]
+        xs = np.stack([np.stack([w.features for w in window[f * K : (f + 1) * K]]) for f in range(F)])
+        ys = np.stack([np.stack([w.labels for w in window[f * K : (f + 1) * K]]) for f in range(F)])
+        fms = self._stack_masks([w.features_mask for w in window], K, F)
+        lms = self._stack_masks([w.labels_mask for w in window], K, F)
+        rngs = jax.random.split(self.net._keys.next(), F * K).reshape(F, K)
+        return self._step_fn(
+            params_k, upd_k, ns_k, jnp.asarray(float(iteration)),
+            jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
+        )
+
+    @staticmethod
+    def _stack_masks(masks, K, F):
+        if all(m is None for m in masks):
+            return None
+        shaped = [np.asarray(m) for m in masks if m is not None]
+        template = np.ones_like(shaped[0])
+        masks = [np.asarray(m) if m is not None else template for m in masks]
+        return jnp.asarray(
+            np.stack([np.stack(masks[f * K : (f + 1) * K]) for f in range(F)])
+        )

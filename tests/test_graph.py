@@ -1,0 +1,199 @@
+"""ComputationGraph tests (reference TestComputationGraphNetwork /
+TestCompGraphCNN / GradientCheckTestsComputationGraph)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.models.graph import ComputationGraph, GraphConfiguration
+from deeplearning4j_tpu.models.vertices import (
+    ElementWiseVertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    SubsetVertex,
+)
+
+
+def simple_graph(seed=1):
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater("sgd", learning_rate=0.5)
+        .graph()
+        .add_inputs("in")
+        .add_layer("d0", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+        .add_layer("d1", DenseLayer(n_in=4, n_out=8, activation="relu"), "in")
+        .add_vertex("merge", MergeVertex(), "d0", "d1")
+        .add_layer("out", OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                                      activation="softmax"), "merge")
+        .set_outputs("out")
+        .build()
+    )
+    return ComputationGraph(conf).init()
+
+
+def test_topological_order_and_forward():
+    net = simple_graph()
+    x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+
+def test_graph_fit_reduces_score():
+    net = simple_graph()
+    rs = np.random.RandomState(0)
+    x = rs.randn(16, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 16)]
+    s0 = net.score(x, y)
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.score(x, y) < s0 * 0.7
+
+
+def test_graph_json_roundtrip():
+    net = simple_graph()
+    js = net.conf.to_json()
+    conf2 = GraphConfiguration.from_json(js)
+    assert conf2 == net.conf
+    net2 = ComputationGraph(conf2).init()
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(net.output(x)), np.asarray(net2.output(x)))
+
+
+def test_graph_save_restore(tmp_path):
+    net = simple_graph()
+    rs = np.random.RandomState(0)
+    x = rs.randn(8, 4).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 8)]
+    net.fit(x, y)
+    p = tmp_path / "graph.zip"
+    net.save(p)
+    restored = ComputationGraph.load(p)
+    np.testing.assert_allclose(
+        np.asarray(net.output(x)), np.asarray(restored.output(x)), rtol=1e-6
+    )
+
+
+def test_elementwise_residual_gradients():
+    """Residual-style add vertex gradient-checks through both branches
+    (reference GradientCheckTestsComputationGraph elementwise tests)."""
+    conf = (
+        NeuralNetConfiguration.builder()
+        .graph()
+        .add_inputs("in")
+        .add_layer("d0", DenseLayer(n_in=4, n_out=4, activation="tanh"), "in")
+        .add_layer("d1", DenseLayer(n_in=4, n_out=4, activation="tanh"), "d0")
+        .add_vertex("add", ElementWiseVertex(op="add"), "d0", "d1")
+        .add_layer("out", OutputLayer(n_in=4, n_out=2, loss="mcxent",
+                                      activation="softmax"), "add")
+        .set_outputs("out")
+        .build()
+    )
+    net = ComputationGraph(conf).init(dtype=jnp.float64)
+    rs = np.random.RandomState(3)
+    x = rs.randn(6, 4)
+    y = np.eye(2)[rs.randint(0, 2, 6)]
+    assert check_gradients(net, x, y)
+
+
+def test_multi_output_graph():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .updater("sgd", learning_rate=0.1)
+        .graph()
+        .add_inputs("in")
+        .add_layer("trunk", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+        .add_layer("out_a", OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                                        activation="softmax"), "trunk")
+        .add_layer("out_b", OutputLayer(n_in=8, n_out=1, loss="mse",
+                                        activation="identity"), "trunk")
+        .set_outputs("out_a", "out_b")
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    rs = np.random.RandomState(0)
+    x = rs.randn(10, 4).astype(np.float32)
+    ya = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 10)]
+    yb = rs.randn(10, 1).astype(np.float32)
+    s0 = None
+    for _ in range(30):
+        net.fit(x, {"out_a": ya, "out_b": yb})
+        if s0 is None:
+            s0 = net.score_value
+    assert net.score_value < s0
+    outs = net.output(x)
+    assert len(outs) == 2 and outs[0].shape == (10, 2) and outs[1].shape == (10, 1)
+
+
+def test_last_time_step_vertex():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .graph()
+        .add_inputs("in")
+        .add_layer("lstm", GravesLSTM(n_in=3, n_out=5), "in")
+        .add_vertex("last", LastTimeStepVertex(), "lstm")
+        .add_layer("out", OutputLayer(n_in=5, n_out=2, loss="mcxent",
+                                      activation="softmax"), "last")
+        .set_outputs("out")
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    x = np.random.RandomState(0).randn(4, 7, 3).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 2)
+
+
+def test_subset_vertex():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .graph()
+        .add_inputs("in")
+        .add_layer("d", DenseLayer(n_in=4, n_out=10, activation="tanh"), "in")
+        .add_vertex("sub", SubsetVertex(index_from=2, index_to=5), "d")
+        .add_layer("out", OutputLayer(n_in=4, n_out=2, loss="mcxent",
+                                      activation="softmax"), "sub")
+        .set_outputs("out")
+        .build()
+    )
+    net = ComputationGraph(conf).init()
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    assert np.asarray(net.output(x)).shape == (3, 2)
+
+
+def test_cycle_detection():
+    from deeplearning4j_tpu.models.graph import GraphNode
+    from deeplearning4j_tpu.nn.conf import UpdaterConfig
+
+    conf = GraphConfiguration(
+        inputs=("in",),
+        outputs=("a",),
+        nodes=(
+            GraphNode("a", ("b",), layer=DenseLayer(n_in=2, n_out=2, name="a")),
+            GraphNode("b", ("a",), layer=DenseLayer(n_in=2, n_out=2, name="b")),
+        ),
+        updater=UpdaterConfig(),
+    )
+    with pytest.raises(ValueError, match="cycle"):
+        conf.topological_order()
+
+
+def test_resnet_tiny_builds_and_trains():
+    """A 2-stage tiny ResNet via the zoo builder compiles and trains."""
+    from deeplearning4j_tpu.models.zoo import resnet50
+
+    net = resnet50(height=16, width=16, channels=3, n_classes=4,
+                   blocks=(1, 1), stem_stride=1, init_channels=8,
+                   updater="sgd", lr=0.01)
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 16, 16, 3).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 2)]
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 4)
