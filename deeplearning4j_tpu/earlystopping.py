@@ -1,0 +1,416 @@
+"""Early stopping: config-driven train-until-done.
+
+Reference: ``deeplearning4j-nn/.../earlystopping/`` —
+``EarlyStoppingConfiguration.java:45-71`` (builder: modelSaver, epoch/iteration
+termination conditions, saveLastModel, evaluateEveryNEpochs, scoreCalculator),
+``trainer/BaseEarlyStoppingTrainer.java:76-147`` (epoch loop: fit every batch,
+check per-iteration conditions on model score, every-N-epochs compute the
+validation score, track/save best model, check epoch conditions),
+``termination/*.java``, ``saver/{InMemoryModelSaver,LocalFileModelSaver}.java``,
+``scorecalc/DataSetLossCalculator.java``.
+
+Works for both MultiLayerNetwork and ComputationGraph (anything exposing
+``fit(DataSet)``, ``score``, ``save/load`` and ``clone``).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+# ---------------------------------------------------------------------------
+# termination conditions
+# ---------------------------------------------------------------------------
+
+class IterationTerminationCondition:
+    """Checked after every minibatch against the last minibatch score."""
+
+    def initialize(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def terminate(self, last_score: float) -> bool:
+        raise NotImplementedError
+
+
+class EpochTerminationCondition:
+    """Checked at the end of each (evaluated) epoch against validation score."""
+
+    def initialize(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    """≙ ``MaxEpochsTerminationCondition.java``."""
+
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        return epoch + 1 >= self.max_epochs
+
+    def __repr__(self):
+        return f"MaxEpochsTerminationCondition({self.max_epochs})"
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop when score hasn't improved (by > min_improvement) for
+    ``patience`` epochs. ≙ ``ScoreImprovementEpochTerminationCondition.java``."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+
+    def initialize(self) -> None:
+        self.best_score: Optional[float] = None
+        self.epochs_since_improvement = 0
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        if self.best_score is None:
+            self.best_score = score
+            return False
+        if self.best_score - score > self.min_improvement:
+            self.best_score = score
+            self.epochs_since_improvement = 0
+            return False
+        self.epochs_since_improvement += 1
+        return self.epochs_since_improvement >= self.patience
+
+    def __repr__(self):
+        return (f"ScoreImprovementEpochTerminationCondition(patience="
+                f"{self.patience}, minImprovement={self.min_improvement})")
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop as soon as the score drops below a target ("good enough").
+    ≙ ``BestScoreEpochTerminationCondition.java``."""
+
+    def __init__(self, best_expected_score: float):
+        self.best_expected_score = best_expected_score
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        return score < self.best_expected_score
+
+    def __repr__(self):
+        return f"BestScoreEpochTerminationCondition({self.best_expected_score})"
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    """Wall-clock cutoff. ≙ ``MaxTimeIterationTerminationCondition.java``."""
+
+    def __init__(self, max_time_seconds: float):
+        self.max_time_seconds = max_time_seconds
+        self.start = time.time()
+
+    def initialize(self) -> None:
+        self.start = time.time()
+
+    def terminate(self, last_score: float) -> bool:
+        return (time.time() - self.start) > self.max_time_seconds
+
+    def __repr__(self):
+        return f"MaxTimeIterationTerminationCondition({self.max_time_seconds}s)"
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Terminate if score exceeds a ceiling (divergence guard).
+    ≙ ``MaxScoreIterationTerminationCondition.java``."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, last_score: float) -> bool:
+        return last_score > self.max_score
+
+    def __repr__(self):
+        return f"MaxScoreIterationTerminationCondition({self.max_score})"
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    """NaN/Inf guard. ≙ ``InvalidScoreIterationTerminationCondition.java``."""
+
+    def terminate(self, last_score: float) -> bool:
+        return math.isnan(last_score) or math.isinf(last_score)
+
+    def __repr__(self):
+        return "InvalidScoreIterationTerminationCondition()"
+
+
+# ---------------------------------------------------------------------------
+# model savers
+# ---------------------------------------------------------------------------
+
+class EarlyStoppingModelSaver:
+    def save_best_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def save_latest_model(self, net, score: float) -> None:
+        raise NotImplementedError
+
+    def get_best_model(self):
+        raise NotImplementedError
+
+    def get_latest_model(self):
+        raise NotImplementedError
+
+
+class InMemoryModelSaver(EarlyStoppingModelSaver):
+    """≙ ``saver/InMemoryModelSaver.java`` — keeps clones in RAM."""
+
+    def __init__(self):
+        self.best = None
+        self.latest = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self.best = net.clone()
+
+    def save_latest_model(self, net, score: float) -> None:
+        self.latest = net.clone()
+
+    def get_best_model(self):
+        return self.best
+
+    def get_latest_model(self):
+        return self.latest
+
+
+class LocalFileModelSaver(EarlyStoppingModelSaver):
+    """≙ ``saver/LocalFileModelSaver.java`` — bestModel.zip / latestModel.zip
+    in a directory, restored through the model's own serializer."""
+
+    def __init__(self, directory: str, model_cls=None):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._model_cls = model_cls
+
+    @property
+    def best_path(self) -> str:
+        return os.path.join(self.directory, "bestModel.zip")
+
+    @property
+    def latest_path(self) -> str:
+        return os.path.join(self.directory, "latestModel.zip")
+
+    def save_best_model(self, net, score: float) -> None:
+        self._model_cls = self._model_cls or type(net)
+        net.save(self.best_path)
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._model_cls = self._model_cls or type(net)
+        net.save(self.latest_path)
+
+    def get_best_model(self):
+        if not os.path.exists(self.best_path):
+            return None
+        return self._model_cls.load(self.best_path)
+
+    def get_latest_model(self):
+        if not os.path.exists(self.latest_path):
+            return None
+        return self._model_cls.load(self.latest_path)
+
+
+# ---------------------------------------------------------------------------
+# score calculators
+# ---------------------------------------------------------------------------
+
+class ScoreCalculator:
+    def calculate_score(self, net) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss over a validation iterator.
+    ≙ ``scorecalc/DataSetLossCalculator.java`` (average=True semantics)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        self.iterator.reset()
+        total, n = 0.0, 0
+        for ds in self.iterator:
+            b = ds.num_examples()
+            total += net.score(dataset=ds) * b
+            n += b
+        return total / n if self.average and n else total
+
+
+# ---------------------------------------------------------------------------
+# configuration / result / trainer
+# ---------------------------------------------------------------------------
+
+class TerminationReason(enum.Enum):
+    ERROR = "Error"
+    ITERATION_TERMINATION_CONDITION = "IterationTerminationCondition"
+    EPOCH_TERMINATION_CONDITION = "EpochTerminationCondition"
+
+
+@dataclass
+class EarlyStoppingResult:
+    """≙ ``EarlyStoppingResult.java``."""
+
+    termination_reason: TerminationReason
+    termination_details: str
+    score_vs_epoch: Dict[int, float]
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: Any
+
+
+@dataclass
+class EarlyStoppingConfiguration:
+    """≙ ``EarlyStoppingConfiguration.java`` builder fields."""
+
+    model_saver: EarlyStoppingModelSaver = field(default_factory=InMemoryModelSaver)
+    epoch_termination_conditions: List[EpochTerminationCondition] = field(default_factory=list)
+    iteration_termination_conditions: List[IterationTerminationCondition] = field(default_factory=list)
+    save_last_model: bool = False
+    evaluate_every_n_epochs: int = 1
+    score_calculator: Optional[ScoreCalculator] = None
+
+    class Builder:
+        def __init__(self):
+            self._cfg = EarlyStoppingConfiguration()
+
+        def model_saver(self, saver):
+            self._cfg.model_saver = saver
+            return self
+
+        def epoch_termination_conditions(self, *conds):
+            self._cfg.epoch_termination_conditions = list(conds)
+            return self
+
+        def iteration_termination_conditions(self, *conds):
+            self._cfg.iteration_termination_conditions = list(conds)
+            return self
+
+        def save_last_model(self, b: bool = True):
+            self._cfg.save_last_model = b
+            return self
+
+        def evaluate_every_n_epochs(self, n: int):
+            self._cfg.evaluate_every_n_epochs = n
+            return self
+
+        def score_calculator(self, sc):
+            self._cfg.score_calculator = sc
+            return self
+
+        def build(self):
+            return self._cfg
+
+
+class EarlyStoppingTrainer:
+    """≙ ``trainer/BaseEarlyStoppingTrainer.java:76-147``: the epoch loop.
+
+    Single implementation covers MLN and CG (reference has one subclass per
+    facade; our facades share the fit/score/clone surface).
+    """
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator,
+                 listener: Optional[Any] = None):
+        self.config = config
+        self.net = net
+        self.train = train_iterator
+        self.listener = listener
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.iteration_termination_conditions:
+            c.initialize()
+        for c in cfg.epoch_termination_conditions:
+            c.initialize()
+        if self.listener is not None:
+            self.listener.on_start(cfg, self.net)
+
+        score_vs_epoch: Dict[int, float] = {}
+        best_score = float("inf")
+        best_epoch = -1
+        epoch = 0
+        while True:
+            self.train.reset()
+            terminate = False
+            reason: Optional[IterationTerminationCondition] = None
+            try:
+                for ds in self.train:
+                    if hasattr(ds, "features"):
+                        self.net.fit(ds.features, ds.labels,
+                                     fmask=getattr(ds, "features_mask", None),
+                                     lmask=getattr(ds, "labels_mask", None))
+                    else:
+                        x, y = ds[0], ds[1]
+                        self.net.fit(x, y)
+                    last_score = self.net.score_value
+                    for c in cfg.iteration_termination_conditions:
+                        if c.terminate(last_score):
+                            terminate, reason = True, c
+                            break
+                    if terminate:
+                        break
+            except Exception as e:  # ≙ reference Error termination path
+                return EarlyStoppingResult(
+                    TerminationReason.ERROR, repr(e), score_vs_epoch,
+                    best_epoch, best_score, epoch,
+                    cfg.model_saver.get_best_model())
+
+            if terminate:
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.net, 0.0)
+                best = cfg.model_saver.get_best_model()
+                if self.listener is not None:
+                    self.listener.on_completion(None)
+                return EarlyStoppingResult(
+                    TerminationReason.ITERATION_TERMINATION_CONDITION,
+                    repr(reason), score_vs_epoch, best_epoch, best_score,
+                    epoch, best)
+
+            # every-N-epochs validation scoring (≙ evaluateEveryNEpochs)
+            evaluate = (epoch == 0 or (epoch + 1) % cfg.evaluate_every_n_epochs == 0)
+            score = 0.0
+            if evaluate:
+                if cfg.score_calculator is not None:
+                    score = cfg.score_calculator.calculate_score(self.net)
+                score_vs_epoch[epoch] = score
+                if self.listener is not None:
+                    self.listener.on_epoch(epoch, score, cfg, self.net)
+                if score < best_score:
+                    best_score, best_epoch = score, epoch
+                    cfg.model_saver.save_best_model(self.net, score)
+            if cfg.save_last_model:
+                cfg.model_saver.save_latest_model(self.net, score)
+
+            for c in cfg.epoch_termination_conditions:
+                if c.terminate(epoch, score):
+                    best = cfg.model_saver.get_best_model()
+                    result = EarlyStoppingResult(
+                        TerminationReason.EPOCH_TERMINATION_CONDITION,
+                        repr(c), score_vs_epoch, best_epoch, best_score,
+                        epoch + 1, best)
+                    if self.listener is not None:
+                        self.listener.on_completion(result)
+                    return result
+            epoch += 1
+
+
+class EarlyStoppingListener:
+    """≙ ``listener/EarlyStoppingListener.java`` hook surface."""
+
+    def on_start(self, config, net) -> None:  # pragma: no cover - hook
+        pass
+
+    def on_epoch(self, epoch, score, config, net) -> None:  # pragma: no cover
+        pass
+
+    def on_completion(self, result) -> None:  # pragma: no cover - hook
+        pass

@@ -70,6 +70,8 @@ class GraphConfiguration:
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
     tbptt_back_length: int = 20
+    optimization_algo: str = "stochastic_gradient_descent"
+    num_iterations: int = 1
 
     def topological_order(self) -> List[str]:
         """Kahn's algorithm over the DAG (reference
@@ -121,6 +123,8 @@ class GraphConfiguration:
                 "backprop_type": self.backprop_type,
                 "tbptt_fwd_length": self.tbptt_fwd_length,
                 "tbptt_back_length": self.tbptt_back_length,
+                "optimization_algo": self.optimization_algo,
+                "num_iterations": self.num_iterations,
             },
             indent=2,
         )
@@ -138,6 +142,8 @@ class GraphConfiguration:
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_back_length=d.get("tbptt_back_length", 20),
+            optimization_algo=d.get("optimization_algo", "stochastic_gradient_descent"),
+            num_iterations=d.get("num_iterations", 1),
         )
 
 
@@ -180,6 +186,8 @@ class GraphBuilder:
             updater=p._updater,
             input_types={k: v.to_dict() for k, v in self._input_types.items()} or None,
             seed=p._seed,
+            optimization_algo=p._optimization_algo,
+            num_iterations=p._num_iterations,
         )
         conf.validate()
         # shape inference pass: complete layers with n_in from input types
@@ -404,6 +412,8 @@ class ComputationGraph:
         return self
 
     def _one_step(self, x, y, fm, lm):
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            return self._fit_solver(x, y, fm, lm)
         step = self._get_train_step()
         x = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x))
         y = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(y))
@@ -414,6 +424,46 @@ class ComputationGraph:
             None if lm is None else jnp.asarray(lm),
         )
         self.score_value = float(loss)
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration)
+
+    def _fit_solver(self, x, y, fm, lm):
+        """Full-batch solver path (CG/LBFGS/line-search GD); see
+        ``MultiLayerNetwork._fit_solver``. Reference ``Solver.java:47-74``."""
+        import numpy as np
+
+        import jax.flatten_util
+
+        from deeplearning4j_tpu.optimize import solvers as solvers_mod
+
+        rng = self._keys.next()
+        x = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x))
+        y = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(y))
+        fm = None if fm is None else jnp.asarray(fm)
+        lm = None if lm is None else jnp.asarray(lm)
+        flat0, unravel = jax.flatten_util.ravel_pytree(self.params)
+        net_state = self.net_state
+
+        @jax.jit
+        def vg(vec):
+            p = unravel(vec)
+            (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+                p, net_state, x, y, rng, fm, lm
+            )
+            gflat, _ = jax.flatten_util.ravel_pytree(grads)
+            return loss, gflat
+
+        def value_grad(v):
+            loss, g = vg(jnp.asarray(v, flat0.dtype))
+            return float(loss), np.asarray(g, np.float64)
+
+        xf, fx = solvers_mod.solve(
+            self.conf.optimization_algo, value_grad,
+            np.asarray(flat0, np.float64), self.conf.num_iterations,
+        )
+        self.params = unravel(jnp.asarray(xf, flat0.dtype))
+        self.score_value = float(fx)
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
@@ -442,7 +492,10 @@ class ComputationGraph:
 
     def score(self, inputs=None, labels=None, dataset=None) -> float:
         if dataset is not None:
-            inputs, labels = dataset[0], dataset[1]
+            if hasattr(dataset, "features"):
+                inputs, labels = dataset.features, dataset.labels
+            else:
+                inputs, labels = dataset[0], dataset[1]
         inputs = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(inputs))
         labels = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(labels))
         loss, _ = self._loss_fn(self.params, self.net_state, inputs, labels,
@@ -452,6 +505,14 @@ class ComputationGraph:
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)
         return self
+
+    def clone(self) -> "ComputationGraph":
+        net = ComputationGraph(self.conf)
+        net.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        net.net_state = jax.tree_util.tree_map(lambda a: a, self.net_state)
+        net.updater_state = jax.tree_util.tree_map(lambda a: a, self.updater_state)
+        net.iteration = self.iteration
+        return net
 
     def save(self, path, save_updater: bool = True):
         from deeplearning4j_tpu.models import serialization

@@ -201,6 +201,11 @@ class MultiLayerNetwork:
         return self
 
     def _fit_batches(self, batches):
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            for batch in batches:
+                x, y, fm, lm = self._unpack(batch)
+                self._fit_solver(x, y, fm, lm)
+            return
         step = self._get_train_step()
         tbptt = self.conf.backprop_type == "truncated_bptt"
         for batch in batches:
@@ -210,6 +215,45 @@ class MultiLayerNetwork:
                     self._fit_tbptt(step, x, y, fm, lm)
                 else:
                     self._one_step(step, x, y, fm, lm, carries=None)
+
+    def _fit_solver(self, x, y, fm, lm):
+        """Full-batch solver path (CG/LBFGS/line-search GD) over the flat
+        param vector.  Reference ``Solver.java:47-74`` dispatch +
+        ``BaseOptimizer.java:165`` iterative optimize."""
+        import jax.flatten_util
+
+        from deeplearning4j_tpu.optimize import solvers as solvers_mod
+
+        rng = self._keys.next()
+        x = jnp.asarray(x)
+        y = jnp.asarray(y)
+        fm = None if fm is None else jnp.asarray(fm)
+        lm = None if lm is None else jnp.asarray(lm)
+        flat0, unravel = jax.flatten_util.ravel_pytree(self.params)
+        net_state = self.net_state
+
+        @jax.jit
+        def vg(vec):
+            p = unravel(vec)
+            (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+                p, net_state, x, y, rng, fm, lm, None
+            )
+            gflat, _ = jax.flatten_util.ravel_pytree(grads)
+            return loss, gflat
+
+        def value_grad(v):
+            loss, g = vg(jnp.asarray(v, flat0.dtype))
+            return float(loss), np.asarray(g, np.float64)
+
+        xf, fx = solvers_mod.solve(
+            self.conf.optimization_algo, value_grad,
+            np.asarray(flat0, np.float64), self.conf.num_iterations,
+        )
+        self.params = unravel(jnp.asarray(xf, flat0.dtype))
+        self.score_value = float(fx)
+        self.iteration += 1
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration)
 
     def _one_step(self, step, x, y, fm, lm, carries):
         rng = self._keys.next()
@@ -284,7 +328,12 @@ class MultiLayerNetwork:
 
     def score(self, x=None, y=None, dataset=None, fmask=None, lmask=None) -> float:
         if dataset is not None:
-            x, y = dataset[0], dataset[1]
+            if hasattr(dataset, "features"):
+                x, y = dataset.features, dataset.labels
+                fmask = fmask if fmask is not None else getattr(dataset, "features_mask", None)
+                lmask = lmask if lmask is not None else getattr(dataset, "labels_mask", None)
+            else:
+                x, y = dataset[0], dataset[1]
         loss, _ = self._loss_fn(self.params, self.net_state, jnp.asarray(x),
                                 jnp.asarray(y), None, fmask, lmask, train=False)
         return float(loss)

@@ -1,0 +1,161 @@
+"""Full-batch second-order-ish solvers: line search GD, conjugate gradient,
+L-BFGS — plus the backtracking line search they share.
+
+Reference: ``optimize/Solver.java:41-74`` (dispatch on OptimizationAlgorithm),
+``optimize/solvers/BaseOptimizer.java:165`` (iterative optimize loop),
+``BackTrackLineSearch.java``, ``ConjugateGradient.java``, ``LBFGS.java``,
+``LineGradientDescent.java``, step functions ``optimize/stepfunctions/*``.
+
+TPU redesign: the objective is a jitted scalar function of the ONE flattened
+parameter vector (the reference's flattened-params invariant makes this the
+natural interface — ``MultiLayerNetwork.java:97-98``); the search direction
+math (two-loop recursion, Polak-Ribière β, Armijo backtracking) runs as tiny
+host-side numpy over device-computed value/grad pairs, so each line-search
+probe is one XLA call.  SGD itself does NOT live here — it is the jitted
+train step in the model facades.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Tuple
+
+import numpy as np
+
+
+class BackTrackLineSearch:
+    """Armijo backtracking. ≙ ``optimize/solvers/BackTrackLineSearch.java``.
+
+    Returns the accepted step size along ``direction`` (0.0 if no step
+    improves sufficiently).
+    """
+
+    def __init__(self, max_iterations: int = 20, c1: float = 1e-4,
+                 shrink: float = 0.5, initial_step: float = 1.0,
+                 max_step: float = 100.0):
+        self.max_iterations = max_iterations
+        self.c1 = c1
+        self.shrink = shrink
+        self.initial_step = initial_step
+        self.max_step = max_step
+
+    def optimize(self, f: Callable[[np.ndarray], float], x: np.ndarray,
+                 fx: float, grad: np.ndarray, direction: np.ndarray) -> float:
+        dg = float(np.dot(grad, direction))
+        if dg >= 0:  # not a descent direction (reference ZeroDirection guard)
+            return 0.0
+        # clip overly long steps (reference stpmax logic)
+        dnorm = float(np.linalg.norm(direction))
+        step = min(self.initial_step, self.max_step / max(dnorm, 1e-12))
+        for _ in range(self.max_iterations):
+            trial = f(x + step * direction)
+            if np.isfinite(trial) and trial <= fx + self.c1 * step * dg:
+                return step
+            step *= self.shrink
+        return 0.0
+
+
+ValueGrad = Callable[[np.ndarray], Tuple[float, np.ndarray]]
+
+
+def line_gradient_descent(value_grad: ValueGrad, x0: np.ndarray,
+                          iterations: int,
+                          line_search: BackTrackLineSearch = None) -> Tuple[np.ndarray, float]:
+    """Steepest descent with line search. ≙ ``LineGradientDescent.java``."""
+    ls = line_search or BackTrackLineSearch()
+    f = lambda v: value_grad(v)[0]
+    x = np.asarray(x0, np.float64).copy()
+    fx, g = value_grad(x)
+    for _ in range(iterations):
+        d = -g
+        step = ls.optimize(f, x, fx, g, d)
+        if step == 0.0:
+            break
+        x = x + step * d
+        fx, g = value_grad(x)
+    return x, fx
+
+
+def conjugate_gradient(value_grad: ValueGrad, x0: np.ndarray,
+                       iterations: int,
+                       line_search: BackTrackLineSearch = None) -> Tuple[np.ndarray, float]:
+    """Nonlinear CG, Polak-Ribière+ with automatic restart.
+    ≙ ``ConjugateGradient.java``."""
+    ls = line_search or BackTrackLineSearch()
+    f = lambda v: value_grad(v)[0]
+    x = np.asarray(x0, np.float64).copy()
+    fx, g = value_grad(x)
+    d = -g
+    for _ in range(iterations):
+        step = ls.optimize(f, x, fx, g, d)
+        if step == 0.0:
+            # restart along steepest descent once before giving up
+            d = -g
+            step = ls.optimize(f, x, fx, g, d)
+            if step == 0.0:
+                break
+        x = x + step * d
+        fx, g_new = value_grad(x)
+        beta = float(np.dot(g_new, g_new - g) / max(np.dot(g, g), 1e-300))
+        beta = max(beta, 0.0)  # PR+
+        d = -g_new + beta * d
+        g = g_new
+    return x, fx
+
+
+def lbfgs(value_grad: ValueGrad, x0: np.ndarray, iterations: int,
+          memory: int = 10,
+          line_search: BackTrackLineSearch = None) -> Tuple[np.ndarray, float]:
+    """Limited-memory BFGS (two-loop recursion). ≙ ``LBFGS.java``."""
+    ls = line_search or BackTrackLineSearch()
+    f = lambda v: value_grad(v)[0]
+    x = np.asarray(x0, np.float64).copy()
+    fx, g = value_grad(x)
+    s_hist: deque = deque(maxlen=memory)
+    y_hist: deque = deque(maxlen=memory)
+    for _ in range(iterations):
+        # two-loop recursion for H·g
+        q = g.copy()
+        alphas = []
+        for s, y in reversed(list(zip(s_hist, y_hist))):
+            rho = 1.0 / max(float(np.dot(y, s)), 1e-300)
+            a = rho * float(np.dot(s, q))
+            alphas.append((a, rho, s, y))
+            q -= a * y
+        if y_hist:
+            s, y = s_hist[-1], y_hist[-1]
+            q *= float(np.dot(s, y)) / max(float(np.dot(y, y)), 1e-300)
+        for a, rho, s, y in reversed(alphas):
+            b = rho * float(np.dot(y, q))
+            q += (a - b) * s
+        d = -q
+        step = ls.optimize(f, x, fx, g, d)
+        if step == 0.0:
+            d = -g
+            step = ls.optimize(f, x, fx, g, d)
+            if step == 0.0:
+                break
+        x_new = x + step * d
+        fx, g_new = value_grad(x_new)
+        s_vec, y_vec = x_new - x, g_new - g
+        if float(np.dot(s_vec, y_vec)) > 1e-10:  # curvature condition
+            s_hist.append(s_vec)
+            y_hist.append(y_vec)
+        x, g = x_new, g_new
+    return x, fx
+
+
+SOLVERS = {
+    "line_gradient_descent": line_gradient_descent,
+    "conjugate_gradient": conjugate_gradient,
+    "lbfgs": lbfgs,
+}
+
+
+def solve(algo: str, value_grad: ValueGrad, x0: np.ndarray,
+          iterations: int) -> Tuple[np.ndarray, float]:
+    """Dispatch ≙ ``Solver.java:47-74``."""
+    if algo not in SOLVERS:
+        raise ValueError(f"Unknown optimization algorithm '{algo}' "
+                         f"(known: {sorted(SOLVERS)} + stochastic_gradient_descent)")
+    return SOLVERS[algo](value_grad, x0, iterations)
