@@ -207,15 +207,22 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
         self._model_cls = self._model_cls or type(net)
         net.save(self.latest_path)
 
+    def _load(self, path):
+        if self._model_cls is not None:
+            return self._model_cls.load(path)
+        from deeplearning4j_tpu.models import serialization
+
+        return serialization.load_model(path)
+
     def get_best_model(self):
         if not os.path.exists(self.best_path):
             return None
-        return self._model_cls.load(self.best_path)
+        return self._load(self.best_path)
 
     def get_latest_model(self):
         if not os.path.exists(self.latest_path):
             return None
-        return self._model_cls.load(self.latest_path)
+        return self._load(self.latest_path)
 
 
 # ---------------------------------------------------------------------------
@@ -359,23 +366,29 @@ class EarlyStoppingTrainer:
                     if terminate:
                         break
             except Exception as e:  # ≙ reference Error termination path
-                return EarlyStoppingResult(
+                result = EarlyStoppingResult(
                     TerminationReason.ERROR, repr(e), score_vs_epoch,
                     best_epoch, best_score, epoch,
                     cfg.model_saver.get_best_model())
+                if self.listener is not None:
+                    self.listener.on_completion(result)
+                return result
 
             if terminate:
                 if cfg.save_last_model:
-                    cfg.model_saver.save_latest_model(self.net, 0.0)
-                best = cfg.model_saver.get_best_model()
-                if self.listener is not None:
-                    self.listener.on_completion(None)
-                return EarlyStoppingResult(
+                    cfg.model_saver.save_latest_model(self.net, self.net.score_value)
+                result = EarlyStoppingResult(
                     TerminationReason.ITERATION_TERMINATION_CONDITION,
                     repr(reason), score_vs_epoch, best_epoch, best_score,
-                    epoch, best)
+                    epoch, cfg.model_saver.get_best_model())
+                if self.listener is not None:
+                    self.listener.on_completion(result)
+                return result
 
-            # every-N-epochs validation scoring (≙ evaluateEveryNEpochs)
+            # every-N-epochs validation scoring; epoch termination conditions
+            # are only checked on evaluated epochs so they never see a stale
+            # or placeholder score (≙ evaluateEveryNEpochs gating in the
+            # reference epoch loop)
             evaluate = (epoch == 0 or (epoch + 1) % cfg.evaluate_every_n_epochs == 0)
             score = 0.0
             if evaluate:
@@ -390,16 +403,16 @@ class EarlyStoppingTrainer:
             if cfg.save_last_model:
                 cfg.model_saver.save_latest_model(self.net, score)
 
-            for c in cfg.epoch_termination_conditions:
-                if c.terminate(epoch, score):
-                    best = cfg.model_saver.get_best_model()
-                    result = EarlyStoppingResult(
-                        TerminationReason.EPOCH_TERMINATION_CONDITION,
-                        repr(c), score_vs_epoch, best_epoch, best_score,
-                        epoch + 1, best)
-                    if self.listener is not None:
-                        self.listener.on_completion(result)
-                    return result
+            if evaluate:
+                for c in cfg.epoch_termination_conditions:
+                    if c.terminate(epoch, score):
+                        result = EarlyStoppingResult(
+                            TerminationReason.EPOCH_TERMINATION_CONDITION,
+                            repr(c), score_vs_epoch, best_epoch, best_score,
+                            epoch + 1, cfg.model_saver.get_best_model())
+                        if self.listener is not None:
+                            self.listener.on_completion(result)
+                        return result
             epoch += 1
 
 

@@ -431,42 +431,24 @@ class ComputationGraph:
     def _fit_solver(self, x, y, fm, lm):
         """Full-batch solver path (CG/LBFGS/line-search GD); see
         ``MultiLayerNetwork._fit_solver``. Reference ``Solver.java:47-74``."""
-        import numpy as np
-
-        import jax.flatten_util
-
         from deeplearning4j_tpu.optimize import solvers as solvers_mod
 
-        rng = self._keys.next()
-        x = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x))
-        y = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(y))
-        fm = None if fm is None else jnp.asarray(fm)
-        lm = None if lm is None else jnp.asarray(lm)
-        flat0, unravel = jax.flatten_util.ravel_pytree(self.params)
-        net_state = self.net_state
-
-        @jax.jit
-        def vg(vec):
-            p = unravel(vec)
-            (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
-                p, net_state, x, y, rng, fm, lm
-            )
-            gflat, _ = jax.flatten_util.ravel_pytree(grads)
-            return loss, gflat
-
-        def value_grad(v):
-            loss, g = vg(jnp.asarray(v, flat0.dtype))
-            return float(loss), np.asarray(g, np.float64)
-
-        xf, fx = solvers_mod.solve(
-            self.conf.optimization_algo, value_grad,
-            np.asarray(flat0, np.float64), self.conf.num_iterations,
+        args = (
+            self.net_state,
+            jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x)),
+            jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(y)),
+            self._keys.next(),
+            None if fm is None else jnp.asarray(fm),
+            None if lm is None else jnp.asarray(lm),
         )
-        self.params = unravel(jnp.asarray(xf, flat0.dtype))
-        self.score_value = float(fx)
-        self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration)
+
+        def loss_fn(params, net_state, x, y, rng, fm, lm):
+            return self._loss_fn(params, net_state, x, y, rng, fm, lm)
+
+        solvers_mod.fit_model_with_solver(
+            self, loss_fn, args, self.conf.optimization_algo,
+            self.conf.num_iterations,
+        )
 
     # ------------------------------------------------------------ inference
     def output(self, inputs, fmask=None):
@@ -490,16 +472,19 @@ class ComputationGraph:
         )
         return outs[0] if len(outs) == 1 else outs
 
-    def score(self, inputs=None, labels=None, dataset=None) -> float:
+    def score(self, inputs=None, labels=None, dataset=None, fmask=None,
+              lmask=None) -> float:
         if dataset is not None:
             if hasattr(dataset, "features"):
                 inputs, labels = dataset.features, dataset.labels
+                fmask = fmask if fmask is not None else getattr(dataset, "features_mask", None)
+                lmask = lmask if lmask is not None else getattr(dataset, "labels_mask", None)
             else:
                 inputs, labels = dataset[0], dataset[1]
         inputs = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(inputs))
         labels = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(labels))
         loss, _ = self._loss_fn(self.params, self.net_state, inputs, labels,
-                                None, train=False)
+                                None, fmask=fmask, lmask=lmask, train=False)
         return float(loss)
 
     def set_listeners(self, *listeners):

@@ -220,40 +220,21 @@ class MultiLayerNetwork:
         """Full-batch solver path (CG/LBFGS/line-search GD) over the flat
         param vector.  Reference ``Solver.java:47-74`` dispatch +
         ``BaseOptimizer.java:165`` iterative optimize."""
-        import jax.flatten_util
-
         from deeplearning4j_tpu.optimize import solvers as solvers_mod
 
-        rng = self._keys.next()
-        x = jnp.asarray(x)
-        y = jnp.asarray(y)
-        fm = None if fm is None else jnp.asarray(fm)
-        lm = None if lm is None else jnp.asarray(lm)
-        flat0, unravel = jax.flatten_util.ravel_pytree(self.params)
-        net_state = self.net_state
-
-        @jax.jit
-        def vg(vec):
-            p = unravel(vec)
-            (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
-                p, net_state, x, y, rng, fm, lm, None
-            )
-            gflat, _ = jax.flatten_util.ravel_pytree(grads)
-            return loss, gflat
-
-        def value_grad(v):
-            loss, g = vg(jnp.asarray(v, flat0.dtype))
-            return float(loss), np.asarray(g, np.float64)
-
-        xf, fx = solvers_mod.solve(
-            self.conf.optimization_algo, value_grad,
-            np.asarray(flat0, np.float64), self.conf.num_iterations,
+        args = (
+            self.net_state, jnp.asarray(x), jnp.asarray(y), self._keys.next(),
+            None if fm is None else jnp.asarray(fm),
+            None if lm is None else jnp.asarray(lm),
         )
-        self.params = unravel(jnp.asarray(xf, flat0.dtype))
-        self.score_value = float(fx)
-        self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration)
+
+        def loss_fn(params, net_state, x, y, rng, fm, lm):
+            return self._loss_fn(params, net_state, x, y, rng, fm, lm, None)
+
+        solvers_mod.fit_model_with_solver(
+            self, loss_fn, args, self.conf.optimization_algo,
+            self.conf.num_iterations,
+        )
 
     def _one_step(self, step, x, y, fm, lm, carries):
         rng = self._keys.next()

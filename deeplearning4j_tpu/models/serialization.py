@@ -85,6 +85,20 @@ def write_model(net, path, save_updater: bool = True) -> None:
             zf.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(net.updater_state))
 
 
+def load_model(path, load_updater: bool = True):
+    """Generic restore dispatching on the manifest's model_type
+    (≙ ``ModelSerializer.restoreMultiLayerNetwork``/``restoreComputationGraph``
+    pair, but format-self-describing)."""
+    with zipfile.ZipFile(path, "r") as zf:
+        manifest = json.loads(zf.read(MANIFEST_ENTRY).decode())
+    mtype = manifest.get("model_type")
+    if mtype == "MultiLayerNetwork":
+        return restore_multi_layer_network(path, load_updater)
+    if mtype == "ComputationGraph":
+        return restore_computation_graph(path, load_updater)
+    raise ValueError(f"Unknown model_type '{mtype}' in {path}")
+
+
 def restore_multi_layer_network(path, load_updater: bool = True):
     from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
     from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration

@@ -159,3 +159,52 @@ def solve(algo: str, value_grad: ValueGrad, x0: np.ndarray,
         raise ValueError(f"Unknown optimization algorithm '{algo}' "
                          f"(known: {sorted(SOLVERS)} + stochastic_gradient_descent)")
     return SOLVERS[algo](value_grad, x0, iterations)
+
+
+def fit_model_with_solver(model, loss_fn, args, algo: str, iterations: int) -> None:
+    """One full-batch solver 'fit' on a model facade: run ``iterations`` of
+    the chosen solver over the flat param vector, then write back params,
+    refreshed net_state (BatchNorm running stats etc.), score, iteration
+    count, and fire listeners.  Shared by MultiLayerNetwork and
+    ComputationGraph (≙ the single ``Solver``/``BaseOptimizer`` the
+    reference shares across Model impls).
+
+    ``loss_fn(params, *args) -> (loss, (new_net_state, _))`` must be pure;
+    the jitted value/grad closure is cached on ``model._jit_cache`` keyed by
+    the arg structure+shapes, so repeated batches don't recompile.
+    """
+    import jax
+    import jax.flatten_util
+    import jax.numpy as jnp
+
+    flat0, unravel = jax.flatten_util.ravel_pytree(model.params)
+    leaves = jax.tree_util.tree_leaves(args)
+    key = ("solver_vg", algo, jax.tree_util.tree_structure(args),
+           tuple((l.shape, str(l.dtype)) for l in leaves))
+    if key not in model._jit_cache:
+
+        @jax.jit
+        def vg(vec, args):
+            p = unravel(vec)
+            (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, *args)
+            gflat, _ = jax.flatten_util.ravel_pytree(grads)
+            return loss, gflat, aux
+
+        model._jit_cache[key] = vg
+    vg = model._jit_cache[key]
+
+    def value_grad(v):
+        loss, g, _ = vg(jnp.asarray(v, flat0.dtype), args)
+        return float(loss), np.asarray(g, np.float64)
+
+    xf, fx = solve(algo, value_grad, np.asarray(flat0, np.float64), iterations)
+    xf_dev = jnp.asarray(xf, flat0.dtype)
+    loss, _, aux = vg(xf_dev, args)  # state refresh at the accepted point
+    model.params = unravel(xf_dev)
+    new_state = aux[0]
+    if new_state:
+        model.net_state = new_state
+    model.score_value = float(loss)
+    model.iteration += 1
+    for lst in model.listeners:
+        lst.iteration_done(model, model.iteration)
