@@ -1,0 +1,60 @@
+"""NLP embeddings stack (≙ deeplearning4j-nlp-parent).
+
+TPU-first redesign of the reference's Hogwild embedding trainer: batched
+jitted gather/einsum/scatter kernels over device-resident embedding matrices
+(see ``nlp/learning.py``), one generic SequenceVectors engine, and the
+Word2Vec / ParagraphVectors / GloVe facades on top.
+"""
+
+from deeplearning4j_tpu.nlp.bow import BagOfWordsVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.documents import (
+    AggregatingSentenceIterator,
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    FileSentenceIterator,
+    LabelAwareIterator,
+    LabelledDocument,
+    LabelsSource,
+    SentenceIterator,
+    SimpleLabelAwareIterator,
+)
+from deeplearning4j_tpu.nlp.glove import CoOccurrences, Glove
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.paragraphvectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.sequencevectors import (
+    SequenceVectors,
+    VectorsConfiguration,
+)
+from deeplearning4j_tpu.nlp.tokenization import (
+    STOP_WORDS,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    EndingPreProcessor,
+    NGramTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import (
+    Sequence,
+    SequenceElement,
+    VocabCache,
+    VocabConstructor,
+    VocabWord,
+    build_huffman,
+    codes_matrix,
+)
+from deeplearning4j_tpu.nlp.word2vec import StaticWord2Vec, Word2Vec
+from deeplearning4j_tpu.nlp.wordvectors import WordVectors
+from deeplearning4j_tpu.nlp import serializer as WordVectorSerializer
+
+__all__ = [
+    "BagOfWordsVectorizer", "TfidfVectorizer", "AggregatingSentenceIterator",
+    "BasicLineIterator", "CollectionSentenceIterator", "FileSentenceIterator",
+    "LabelAwareIterator", "LabelledDocument", "LabelsSource",
+    "SentenceIterator", "SimpleLabelAwareIterator", "CoOccurrences", "Glove",
+    "InMemoryLookupTable", "ParagraphVectors", "SequenceVectors",
+    "VectorsConfiguration", "STOP_WORDS", "CommonPreprocessor",
+    "DefaultTokenizerFactory", "EndingPreProcessor", "NGramTokenizerFactory",
+    "TokenizerFactory", "Sequence", "SequenceElement", "VocabCache",
+    "VocabConstructor", "VocabWord", "build_huffman", "codes_matrix",
+    "StaticWord2Vec", "Word2Vec", "WordVectors", "WordVectorSerializer",
+]

@@ -1,0 +1,219 @@
+"""GloVe: co-occurrence counting + weighted-least-squares embedding fit.
+
+Reference: ``models/glove/Glove.java``, ``models/glove/AbstractCoOccurrences
+.java`` (streaming window-weighted co-occurrence counts; 1/d weighting),
+``models/embeddings/learning/impl/elements/GloVe.java`` (per-pair AdaGrad
+update, xMax=100, alpha=0.75).
+
+TPU redesign: co-occurrence counting is a host-side dict pass (the spill-file
+machinery of the reference is an out-of-core detail, not a capability); the
+optimisation loop ships shuffled (row, col, Xij) batches to the jitted
+``glove_step`` kernel (``nlp/learning.py``) — AdaGrad scatter updates on
+device.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Iterable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp import learning
+from deeplearning4j_tpu.nlp.documents import CollectionSentenceIterator, SentenceIterator
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory, TokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import (
+    Sequence,
+    VocabCache,
+    VocabConstructor,
+    VocabWord,
+)
+from deeplearning4j_tpu.nlp.wordvectors import WordVectors
+
+
+class CoOccurrences:
+    """Symmetric window-weighted co-occurrence counts (weight 1/distance).
+    ≙ ``AbstractCoOccurrences.java``."""
+
+    def __init__(self, vocab: VocabCache, window: int = 15,
+                 symmetric: bool = True):
+        self.vocab = vocab
+        self.window = window
+        self.symmetric = symmetric
+        self.counts: Dict[Tuple[int, int], float] = defaultdict(float)
+
+    def fit_sentences(self, token_lists: Iterable[list]) -> "CoOccurrences":
+        for tokens in token_lists:
+            idx = [self.vocab.index_of(t) for t in tokens]
+            idx = [i for i in idx if i >= 0]
+            n = len(idx)
+            for i in range(n):
+                for d in range(1, self.window + 1):
+                    j = i + d
+                    if j >= n:
+                        break
+                    w = 1.0 / d
+                    self.counts[(idx[i], idx[j])] += w
+                    if self.symmetric:
+                        self.counts[(idx[j], idx[i])] += w
+        return self
+
+    def as_arrays(self):
+        if not self.counts:
+            return (np.empty(0, np.int32), np.empty(0, np.int32),
+                    np.empty(0, np.float32))
+        items = list(self.counts.items())
+        rows = np.array([k[0] for k, _ in items], np.int32)
+        cols = np.array([k[1] for k, _ in items], np.int32)
+        vals = np.array([v for _, v in items], np.float32)
+        return rows, cols, vals
+
+
+class Glove(WordVectors):
+    def __init__(self, config=None, sentence_iterator: SentenceIterator = None,
+                 tokenizer_factory: TokenizerFactory = None,
+                 layer_size: int = 100, window: int = 15, epochs: int = 5,
+                 learning_rate: float = 0.05, x_max: float = 100.0,
+                 alpha: float = 0.75, min_word_frequency: int = 1,
+                 batch_size: int = 1024, seed: int = 12345,
+                 symmetric: bool = True):
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.layer_size = layer_size
+        self.window = window
+        self.epochs = epochs
+        self.learning_rate = learning_rate
+        self.x_max = x_max
+        self.alpha = alpha
+        self.min_word_frequency = min_word_frequency
+        self.batch_size = batch_size
+        self.seed = seed
+        self.symmetric = symmetric
+        self.vocab: Optional[VocabCache] = None
+        self.lookup: Optional[InMemoryLookupTable] = None
+        self.cum_loss = 0.0
+
+    def _token_lists(self):
+        self.sentence_iterator.reset()
+        while self.sentence_iterator.has_next():
+            s = self.sentence_iterator.next_sentence()
+            if s:
+                toks = self.tokenizer_factory.create(s).tokens()
+                if toks:
+                    yield toks
+
+    def fit(self) -> "Glove":
+        # vocab
+        def seqs():
+            for toks in self._token_lists():
+                seq = Sequence()
+                for t in toks:
+                    seq.add_element(VocabWord(label=t))
+                yield seq
+
+        self.vocab = VocabConstructor(
+            min_element_frequency=self.min_word_frequency).build_vocab(seqs())
+        V, D = len(self.vocab), self.layer_size
+        cooc = CoOccurrences(self.vocab, self.window, self.symmetric)
+        cooc.fit_sentences(self._token_lists())
+        rows, cols, vals = cooc.as_arrays()
+
+        rs = np.random.RandomState(self.seed)
+        w = jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        wc = jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        hw = jnp.ones((V, D), jnp.float32)
+        hwc = jnp.ones((V, D), jnp.float32)
+        hb = jnp.ones((V,), jnp.float32)
+        hbc = jnp.ones((V,), jnp.float32)
+
+        n = len(rows)
+        B = self.batch_size
+        for _ in range(self.epochs):
+            perm = rs.permutation(n)
+            for i0 in range(0, n, B):
+                sel = perm[i0:i0 + B]
+                pad = B - len(sel)
+                mask = np.concatenate([np.ones(len(sel), np.float32),
+                                       np.zeros(pad, np.float32)])
+                r = np.concatenate([rows[sel], np.zeros(pad, np.int32)])
+                c = np.concatenate([cols[sel], np.zeros(pad, np.int32)])
+                x = np.concatenate([vals[sel], np.ones(pad, np.float32)])
+                (w, wc, b, bc, hw, hwc, hb, hbc, loss) = learning.glove_step(
+                    w, wc, b, bc, hw, hwc, hb, hbc,
+                    jnp.asarray(r), jnp.asarray(c), jnp.asarray(x),
+                    jnp.asarray(mask), jnp.float32(self.learning_rate),
+                    jnp.float32(self.x_max), jnp.float32(self.alpha))
+                self.cum_loss += float(loss)
+
+        # final vectors: w + w̃ (standard GloVe practice)
+        self.lookup = InMemoryLookupTable(self.vocab, D, seed=self.seed,
+                                          use_hs=False)
+        self.lookup.syn0 = w + wc
+        self.lookup._build_neg_cdf()
+        return self
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+            self._iterator = None
+            self._tokenizer = None
+
+        def iterate(self, iterator):
+            if isinstance(iterator, (list, tuple)):
+                iterator = CollectionSentenceIterator(iterator)
+            self._iterator = iterator
+            return self
+
+        def tokenizer_factory(self, tf):
+            self._tokenizer = tf
+            return self
+
+        def layer_size(self, n):
+            self._kw["layer_size"] = n
+            return self
+
+        def window_size(self, n):
+            self._kw["window"] = n
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = n
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr
+            return self
+
+        def x_max(self, x):
+            self._kw["x_max"] = x
+            return self
+
+        def alpha(self, a):
+            self._kw["alpha"] = a
+            return self
+
+        def min_word_frequency(self, n):
+            self._kw["min_word_frequency"] = n
+            return self
+
+        def batch_size(self, n):
+            self._kw["batch_size"] = n
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def symmetric(self, b):
+            self._kw["symmetric"] = b
+            return self
+
+        def build(self) -> "Glove":
+            if self._iterator is None:
+                raise ValueError("Glove.Builder: iterate(...) required")
+            return Glove(sentence_iterator=self._iterator,
+                         tokenizer_factory=self._tokenizer, **self._kw)

@@ -1,0 +1,211 @@
+"""Batched embedding-update kernels: skip-gram / CBOW, negative sampling +
+hierarchical softmax — the TPU re-derivation of the reference's Hogwild hot
+loop.
+
+Reference semantics: ``models/embeddings/learning/impl/elements/
+SkipGram.java:124-194`` (iterateSample: input vector = syn0 row of the
+*context* word, output path/samples of the *center* word; g = (label − σ)·lr;
+accumulate neu1e into the input row) and ``CBOW.java`` (input = mean of
+context rows).  The reference applies these one (center, context) pair at a
+time across lock-free threads (``SequenceVectors.java:907``); that design is
+TPU-hostile, so here a whole batch of pairs becomes ONE XLA program: gathers
+→ einsum logits (MXU) → sigmoid grads → ``.at[].add`` scatter-accumulate.
+Colliding rows inside a batch sum their updates deterministically — the
+batched analogue of Hogwild's unsynchronised overlap, minus the racy reads.
+
+All kernels are donated + jitted; the host only ships index arrays.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _log_sigmoid(x):
+    return -jnp.logaddexp(0.0, -x)
+
+
+def _row_mean_scale(num_rows, idx, m, dtype):
+    """1/multiplicity scale per occurrence, so colliding rows receive the
+    MEAN of their pair-updates instead of the sum.  The reference's Hogwild
+    interleaves collisions one-at-a-time; a batched sum of stale-value
+    updates overshoots (and diverges on small vocabs), so the mean is the
+    stable deterministic analogue."""
+    counts = jnp.zeros((num_rows,), dtype).at[idx].add(m)
+    return 1.0 / jnp.maximum(counts[idx], 1.0)
+
+
+# ---------------------------------------------------------------------------
+# negative-sampling kernels
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def sg_ns_step(syn0, syn1neg, inputs, targets, negs, mask, lr):
+    """One skip-gram negative-sampling batch.
+
+    inputs  [B]    — syn0 rows to train (context words; DBOW: doc labels)
+    targets [B]    — positive output words (window centers)
+    negs    [B,K]  — sampled negative words
+    mask    [B]    — 1.0 for real pairs, 0.0 padding
+    """
+    B, K = negs.shape
+    D = syn0.shape[1]
+    out_idx = jnp.concatenate([targets[:, None], negs], axis=1)      # [B,1+K]
+    labels = jnp.concatenate(
+        [jnp.ones((B, 1), syn0.dtype), jnp.zeros((B, K), syn0.dtype)], axis=1)
+    h = syn0[inputs]                                                 # [B,D]
+    w = syn1neg[out_idx]                                             # [B,1+K,D]
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (labels - jax.nn.sigmoid(logits)) * lr * mask[:, None]       # [B,1+K]
+    dh = jnp.einsum("bk,bkd->bd", g, w)                              # [B,D]
+    dw = g[..., None] * h[:, None, :]                                # [B,1+K,D]
+    in_scale = _row_mean_scale(syn0.shape[0], inputs, mask, syn0.dtype)
+    flat_out = out_idx.reshape(-1)
+    out_mask = jnp.broadcast_to(mask[:, None], out_idx.shape).reshape(-1)
+    out_scale = _row_mean_scale(syn1neg.shape[0], flat_out, out_mask, syn0.dtype)
+    syn0 = syn0.at[inputs].add(dh * in_scale[:, None])
+    syn1neg = syn1neg.at[flat_out].add(dw.reshape(-1, D) * out_scale[:, None])
+    loss = -(mask[:, None] * (labels * _log_sigmoid(logits)
+                              + (1 - labels) * _log_sigmoid(-logits))).sum()
+    return syn0, syn1neg, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def sg_hs_step(syn0, syn1, inputs, points, codes, code_mask, mask, lr):
+    """One skip-gram hierarchical-softmax batch.
+
+    points    [B,L] — inner-node rows (of the center word's Huffman path)
+    codes     [B,L] — bit labels along the path (0/1)
+    code_mask [B,L] — 1.0 within path length
+    """
+    D = syn0.shape[1]
+    h = syn0[inputs]                                                 # [B,D]
+    w = syn1[points]                                                 # [B,L,D]
+    logits = jnp.einsum("bd,bld->bl", h, w)
+    labels = 1.0 - codes                                             # word2vec convention
+    m = code_mask * mask[:, None]
+    g = (labels - jax.nn.sigmoid(logits)) * lr * m                   # [B,L]
+    dh = jnp.einsum("bl,bld->bd", g, w)
+    dw = g[..., None] * h[:, None, :]
+    in_scale = _row_mean_scale(syn0.shape[0], inputs, mask, syn0.dtype)
+    flat_pts = points.reshape(-1)
+    pt_scale = _row_mean_scale(syn1.shape[0], flat_pts, m.reshape(-1), syn0.dtype)
+    syn0 = syn0.at[inputs].add(dh * in_scale[:, None])
+    syn1 = syn1.at[flat_pts].add(dw.reshape(-1, D) * pt_scale[:, None])
+    loss = -(m * (labels * _log_sigmoid(logits)
+                  + (1 - labels) * _log_sigmoid(-logits))).sum()
+    return syn0, syn1, loss
+
+
+# ---------------------------------------------------------------------------
+# CBOW kernels (also Paragraph-Vectors DM when the label row is appended to
+# the context group)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def cbow_ns_step(syn0, syn1neg, contexts, ctx_mask, targets, negs, mask, lr):
+    """One CBOW negative-sampling batch.
+
+    contexts [B,C] — context-word rows (−1-padded → masked by ctx_mask)
+    ctx_mask [B,C] — 1.0 for real context members
+    targets  [B]   — center words to predict
+    """
+    B, K = negs.shape
+    D = syn0.shape[1]
+    safe_ctx = jnp.maximum(contexts, 0)
+    cvecs = syn0[safe_ctx] * ctx_mask[..., None]                     # [B,C,D]
+    counts = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)       # [B,1]
+    h = cvecs.sum(1) / counts                                        # [B,D]
+    out_idx = jnp.concatenate([targets[:, None], negs], axis=1)
+    labels = jnp.concatenate(
+        [jnp.ones((B, 1), syn0.dtype), jnp.zeros((B, K), syn0.dtype)], axis=1)
+    w = syn1neg[out_idx]
+    logits = jnp.einsum("bd,bkd->bk", h, w)
+    g = (labels - jax.nn.sigmoid(logits)) * lr * mask[:, None]
+    dh = jnp.einsum("bk,bkd->bd", g, w) / counts                     # split over members
+    dw = g[..., None] * h[:, None, :]
+    dctx = dh[:, None, :] * ctx_mask[..., None]                      # [B,C,D]
+    flat_ctx = safe_ctx.reshape(-1)
+    ctx_occ = (ctx_mask * mask[:, None]).reshape(-1)
+    ctx_scale = _row_mean_scale(syn0.shape[0], flat_ctx, ctx_occ, syn0.dtype)
+    flat_out = out_idx.reshape(-1)
+    out_mask = jnp.broadcast_to(mask[:, None], out_idx.shape).reshape(-1)
+    out_scale = _row_mean_scale(syn1neg.shape[0], flat_out, out_mask, syn0.dtype)
+    syn0 = syn0.at[flat_ctx].add(dctx.reshape(-1, D) * ctx_scale[:, None])
+    syn1neg = syn1neg.at[flat_out].add(dw.reshape(-1, D) * out_scale[:, None])
+    loss = -(mask[:, None] * (labels * _log_sigmoid(logits)
+                              + (1 - labels) * _log_sigmoid(-logits))).sum()
+    return syn0, syn1neg, loss
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def cbow_hs_step(syn0, syn1, contexts, ctx_mask, points, codes, code_mask, mask, lr):
+    """One CBOW hierarchical-softmax batch."""
+    D = syn0.shape[1]
+    safe_ctx = jnp.maximum(contexts, 0)
+    cvecs = syn0[safe_ctx] * ctx_mask[..., None]
+    counts = jnp.maximum(ctx_mask.sum(-1, keepdims=True), 1.0)
+    h = cvecs.sum(1) / counts
+    w = syn1[points]
+    logits = jnp.einsum("bd,bld->bl", h, w)
+    labels = 1.0 - codes
+    m = code_mask * mask[:, None]
+    g = (labels - jax.nn.sigmoid(logits)) * lr * m
+    dh = jnp.einsum("bl,bld->bd", g, w) / counts
+    dw = g[..., None] * h[:, None, :]
+    dctx = dh[:, None, :] * ctx_mask[..., None]
+    flat_ctx = safe_ctx.reshape(-1)
+    ctx_occ = (ctx_mask * mask[:, None]).reshape(-1)
+    ctx_scale = _row_mean_scale(syn0.shape[0], flat_ctx, ctx_occ, syn0.dtype)
+    flat_pts = points.reshape(-1)
+    pt_scale = _row_mean_scale(syn1.shape[0], flat_pts, m.reshape(-1), syn0.dtype)
+    syn0 = syn0.at[flat_ctx].add(dctx.reshape(-1, D) * ctx_scale[:, None])
+    syn1 = syn1.at[flat_pts].add(dw.reshape(-1, D) * pt_scale[:, None])
+    loss = -(m * (labels * _log_sigmoid(logits)
+                  + (1 - labels) * _log_sigmoid(-logits))).sum()
+    return syn0, syn1, loss
+
+
+# ---------------------------------------------------------------------------
+# GloVe kernel (weighted least squares + AdaGrad)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def glove_step(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, xij, mask, lr,
+               x_max, alpha):
+    """One GloVe batch: minimise f(X)(wᵢ·w̃ⱼ + bᵢ + b̃ⱼ − log Xᵢⱼ)² with
+    per-coordinate AdaGrad.  ≙ ``learning/impl/elements/GloVe.java``
+    (iterateSample) re-batched.
+
+    w/wc   [V,D] main/context embeddings, b/bc [V] biases,
+    h*      AdaGrad squared-grad accumulators.
+    """
+    wi = w[rows]
+    wj = wc[cols]
+    diff = (jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols]
+            - jnp.log(jnp.maximum(xij, 1e-12)))
+    f = jnp.minimum((xij / x_max) ** alpha, 1.0) * mask
+    g = f * diff                                                     # [B]
+    gw = g[:, None] * wj
+    gwc = g[:, None] * wi
+    eps = 1e-8
+
+    def ada(hist, idx, grad):
+        hist = hist.at[idx].add(grad * grad)
+        scale = lr / jnp.sqrt(hist[idx] + eps)
+        return hist, scale * grad
+
+    hw, step_w = ada(hw, rows, gw)
+    hwc, step_wc = ada(hwc, cols, gwc)
+    hb, step_b = ada(hb, rows, g)
+    hbc, step_bc = ada(hbc, cols, g)
+    w = w.at[rows].add(-step_w)
+    wc = wc.at[cols].add(-step_wc)
+    b = b.at[rows].add(-step_b)
+    bc = bc.at[cols].add(-step_bc)
+    loss = 0.5 * (f * diff * diff).sum()
+    return w, wc, b, bc, hw, hwc, hb, hbc, loss

@@ -1,0 +1,95 @@
+"""Weight lookup table: syn0/syn1/syn1Neg + negative-sampling distribution.
+
+Reference: ``models/embeddings/inmemory/InMemoryLookupTable.java:62-138`` —
+``syn0`` (input embeddings), ``syn1`` (hierarchical-softmax inner nodes),
+``syn1Neg`` (negative-sampling output weights), the unigram^0.75 sampling
+table (``table``), and ``resetWeights`` init.
+
+TPU redesign: matrices are ``jax.Array``s living in HBM; negative sampling
+uses inverse-CDF ``searchsorted`` over the unigram^0.75 cumulative (no 100M
+-entry table materialisation); all updates happen in the jitted batch kernels
+(``nlp/learning.py``), never row-by-row from the host.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class InMemoryLookupTable:
+    def __init__(self, cache: VocabCache, vector_length: int,
+                 seed: int = 12345, negative: float = 0.0,
+                 use_hs: bool = True, use_adagrad: bool = False):
+        self.cache = cache
+        self.vector_length = int(vector_length)
+        self.seed = seed
+        self.negative = negative
+        self.use_hs = use_hs
+        self.use_adagrad = use_adagrad
+        self.syn0: Optional[jax.Array] = None
+        self.syn1: Optional[jax.Array] = None
+        self.syn1neg: Optional[jax.Array] = None
+        # per-row AdaGrad accumulators (reference uses per-element AdaGrad
+        # when configured; we keep per-row-per-dim squared-grad sums)
+        self.syn0_hist: Optional[jax.Array] = None
+        self.syn1_hist: Optional[jax.Array] = None
+        self.syn1neg_hist: Optional[jax.Array] = None
+        self._neg_cdf: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------------ init
+    def reset_weights(self) -> None:
+        """≙ ``InMemoryLookupTable.resetWeights`` :133-138 (uniform in
+        [-0.5/D, 0.5/D), syn1 zeros)."""
+        V, D = len(self.cache), self.vector_length
+        rs = np.random.RandomState(self.seed)
+        self.syn0 = jnp.asarray(
+            (rs.rand(V, D).astype(np.float32) - 0.5) / D)
+        if self.use_hs:
+            self.syn1 = jnp.zeros((V, D), jnp.float32)
+        if self.negative > 0:
+            self.syn1neg = jnp.zeros((V, D), jnp.float32)
+        if self.use_adagrad:
+            self.syn0_hist = jnp.zeros((V, D), jnp.float32)
+            self.syn1_hist = jnp.zeros((V, D), jnp.float32) if self.use_hs else None
+            self.syn1neg_hist = jnp.zeros((V, D), jnp.float32) if self.negative > 0 else None
+        self._build_neg_cdf()
+
+    def _build_neg_cdf(self) -> None:
+        """Unigram^0.75 cumulative distribution for inverse-CDF sampling
+        (replaces the reference's materialised ``table``)."""
+        freqs = np.array([w.element_frequency for w in self.cache.vocab_words()],
+                         np.float64)
+        if len(freqs) == 0:
+            self._neg_cdf = None
+            return
+        p = freqs ** 0.75
+        p /= p.sum()
+        self._neg_cdf = jnp.asarray(np.cumsum(p).astype(np.float32))
+
+    def sample_negatives(self, key, shape) -> jax.Array:
+        """Draw negative-sample word indices ~ unigram^0.75."""
+        u = jax.random.uniform(key, shape)
+        return jnp.searchsorted(self._neg_cdf, u).astype(jnp.int32)
+
+    # ----------------------------------------------------------------- query
+    def vector(self, label: str) -> Optional[np.ndarray]:
+        idx = self.cache.index_of(label)
+        if idx < 0 or self.syn0 is None:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def put_vector(self, label: str, vec) -> None:
+        idx = self.cache.index_of(label)
+        if idx < 0:
+            raise KeyError(label)
+        self.syn0 = self.syn0.at[idx].set(jnp.asarray(vec, self.syn0.dtype))
+
+    @property
+    def num_words(self) -> int:
+        return len(self.cache)

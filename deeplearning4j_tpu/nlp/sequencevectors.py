@@ -1,0 +1,302 @@
+"""SequenceVectors — the generic embedding-training engine.
+
+Reference: ``models/sequencevectors/SequenceVectors.java:148-235`` (fit:
+build vocab → Huffman → resetWeights → epochs of multithreaded Hogwild
+training with per-thread linear lr annealing) and its Builder (:735).
+
+TPU redesign: the AsyncSequencer + N VectorCalculationsThreads producer/
+consumer Hogwild architecture is replaced by a *batched pair pipeline*:
+
+  host: sequences → index arrays → (vectorised) window-pair extraction →
+        fixed-size batches (padded, masked)
+  device: ONE jitted kernel per batch (``nlp/learning.py``) — gather,
+        einsum on the MXU, scatter-add — deterministic given the seed.
+
+The linear lr anneal over total processed words is preserved
+(``SequenceVectors.java`` per-thread alpha math), as are subsampling,
+reduced windows, and the SkipGram/CBOW + HS/NS algorithm matrix.
+
+Generic over element streams: Word2Vec feeds tokenised sentences, DeepWalk
+feeds vertex walks, ParagraphVectors feeds labelled documents (labels become
+special vocab elements trained by DBOW/DM — ``impl/sequence/{DBOW,DM}``).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Iterable, List, Optional, Sequence as Seq, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp import learning
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.vocab import (
+    Sequence,
+    SequenceElement,
+    VocabCache,
+    VocabConstructor,
+    build_huffman,
+    codes_matrix,
+)
+from deeplearning4j_tpu.nlp.wordvectors import WordVectors
+
+
+@dataclass
+class VectorsConfiguration:
+    """≙ the reference Builder knobs (``SequenceVectors.Builder`` :735)."""
+
+    layer_size: int = 100
+    window: int = 5
+    negative: int = 0                  # K negative samples; 0 = off
+    use_hierarchic_softmax: bool = True
+    min_word_frequency: int = 1
+    epochs: int = 1
+    iterations: int = 1                # passes over each batch
+    learning_rate: float = 0.025
+    min_learning_rate: float = 1e-4
+    subsampling: float = 0.0           # e.g. 1e-3; 0 = off
+    seed: int = 12345
+    batch_size: int = 512
+    elements_algorithm: str = "skipgram"   # skipgram | cbow
+    sequence_algorithm: str = "dbow"       # dbow | dm (PV only)
+    train_elements: bool = True
+    train_sequences: bool = False      # PV: train label vectors
+    use_adagrad: bool = False
+
+
+class SequenceVectors(WordVectors):
+    def __init__(self, config: VectorsConfiguration,
+                 sequence_provider: Callable[[], Iterable[Sequence]]):
+        """``sequence_provider`` returns a fresh iterable per epoch
+        (≙ iterator reset semantics)."""
+        self.config = config
+        self.sequence_provider = sequence_provider
+        self.vocab: Optional[VocabCache] = None
+        self.lookup: Optional[InMemoryLookupTable] = None
+        self._rs = np.random.RandomState(config.seed)
+        self._key = jax.random.PRNGKey(config.seed)
+        self._codes = self._points = self._code_lengths = None
+        self.cum_loss: float = 0.0
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> "SequenceVectors":
+        cfg = self.config
+        if self.vocab is None:
+            self.vocab = VocabConstructor(
+                min_element_frequency=cfg.min_word_frequency
+            ).build_vocab(self.sequence_provider())
+        if cfg.use_hierarchic_softmax:
+            build_huffman(self.vocab)
+            self._codes, self._points, self._code_lengths = codes_matrix(self.vocab)
+        self.lookup = InMemoryLookupTable(
+            self.vocab, cfg.layer_size, seed=cfg.seed,
+            negative=cfg.negative, use_hs=cfg.use_hierarchic_softmax,
+            use_adagrad=cfg.use_adagrad)
+        self.lookup.reset_weights()
+
+        total_words = self.vocab.total_word_count * max(cfg.epochs, 1)
+        processed = 0
+        for _ in range(cfg.epochs):
+            for batch in self._batches():
+                lr = max(cfg.min_learning_rate,
+                         cfg.learning_rate * (1.0 - processed / max(total_words, 1.0)))
+                for _ in range(cfg.iterations):
+                    self._apply_batch(batch, lr)
+                processed += batch["n_words"]
+        return self
+
+    # ------------------------------------------------- pair/batch generation
+    def _sequence_indices(self, seq: Sequence) -> Tuple[np.ndarray, Optional[int]]:
+        idx = [self.vocab.index_of(el.label if isinstance(el, SequenceElement)
+                                   else str(el))
+               for el in seq.elements]
+        idx = np.array([i for i in idx if i >= 0], np.int32)
+        cfg = self.config
+        if cfg.subsampling > 0 and len(idx):
+            freqs = np.array(
+                [self.vocab.element_at_index(i).element_frequency for i in idx],
+                np.float64)
+            ran = (np.sqrt(freqs / (cfg.subsampling * self.vocab.total_word_count)) + 1) \
+                * (cfg.subsampling * self.vocab.total_word_count) / np.maximum(freqs, 1e-12)
+            idx = idx[self._rs.rand(len(idx)) < ran]
+        label_idx = None
+        if seq.sequence_label is not None:
+            li = self.vocab.index_of(seq.sequence_label.label)
+            label_idx = li if li >= 0 else None
+        return idx, label_idx
+
+    def _window_pairs(self, idx: np.ndarray):
+        """Skip-gram pairs (input=context row, target=center) with reduced
+        windows — vectorised per shift distance."""
+        n = len(idx)
+        if n < 2:
+            return np.empty((0, 2), np.int32), np.empty((0,), np.int32)
+        b = self._rs.randint(1, self.config.window + 1, size=n)
+        inputs, targets, centers_pos = [], [], []
+        for s in range(1, self.config.window + 1):
+            m = b >= s
+            # context at center-s (center index i >= s)
+            sel = np.nonzero(m[s:])[0] + s
+            inputs.append(idx[sel - s]); targets.append(idx[sel]); centers_pos.append(sel)
+            # context at center+s
+            sel2 = np.nonzero(m[:n - s])[0]
+            inputs.append(idx[sel2 + s]); targets.append(idx[sel2]); centers_pos.append(sel2)
+        return (np.stack([np.concatenate(inputs), np.concatenate(targets)], 1),
+                np.concatenate(centers_pos))
+
+    def _context_groups(self, idx: np.ndarray):
+        """CBOW groups: per center, the (−1-padded) context window."""
+        n = len(idx)
+        C = 2 * self.config.window
+        if n < 2:
+            return (np.empty((0, C), np.int32), np.empty((0,), np.int32))
+        b = self._rs.randint(1, self.config.window + 1, size=n)
+        ctx = np.full((n, C), -1, np.int32)
+        for i in range(n):
+            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+            members = np.concatenate([idx[lo:i], idx[i + 1:hi]])
+            ctx[i, :len(members)] = members
+        return ctx, idx
+
+    def _batches(self):
+        """Assemble fixed-size training batches from the sequence stream."""
+        cfg = self.config
+        algo = cfg.elements_algorithm
+        buf_inputs: List[np.ndarray] = []
+        buf_targets: List[np.ndarray] = []
+        buf_ctx: List[np.ndarray] = []
+        count = 0
+        n_words = 0
+
+        def flush():
+            nonlocal buf_inputs, buf_targets, buf_ctx, count, n_words
+            if count == 0:
+                return None
+            if algo == "skipgram" or not cfg.train_elements:
+                inputs = np.concatenate(buf_inputs) if buf_inputs else np.empty(0, np.int32)
+                targets = np.concatenate(buf_targets) if buf_targets else np.empty(0, np.int32)
+                batch = {"kind": "sg", "inputs": inputs, "targets": targets,
+                         "n_words": n_words}
+            else:
+                ctx = np.concatenate(buf_ctx) if buf_ctx else np.empty((0, 2 * cfg.window), np.int32)
+                targets = np.concatenate(buf_targets) if buf_targets else np.empty(0, np.int32)
+                batch = {"kind": "cbow", "contexts": ctx, "targets": targets,
+                         "n_words": n_words}
+            buf_inputs, buf_targets, buf_ctx = [], [], []
+            count = 0
+            n_words = 0
+            return batch
+
+        for seq in self.sequence_provider():
+            idx, label_idx = self._sequence_indices(seq)
+            n_words += len(idx)
+            if cfg.train_elements:
+                if algo == "skipgram":
+                    pairs, _ = self._window_pairs(idx)
+                    if len(pairs):
+                        if cfg.train_sequences and label_idx is not None \
+                                and cfg.sequence_algorithm == "dm":
+                            pass  # DM handled via context groups below
+                        buf_inputs.append(pairs[:, 0])
+                        buf_targets.append(pairs[:, 1])
+                        count += len(pairs)
+                else:  # cbow
+                    ctx, centers = self._context_groups(idx)
+                    if cfg.train_sequences and label_idx is not None and len(centers):
+                        ctx = np.concatenate(
+                            [ctx, np.full((len(ctx), 1), label_idx, np.int32)], 1)
+                    if len(centers):
+                        buf_ctx.append(ctx)
+                        buf_targets.append(centers)
+                        count += len(centers)
+            if cfg.train_sequences and label_idx is not None:
+                if cfg.sequence_algorithm == "dbow" or not cfg.train_elements:
+                    # DBOW: label row predicts every word of the sequence
+                    if len(idx):
+                        buf_inputs.append(np.full(len(idx), label_idx, np.int32))
+                        buf_targets.append(idx)
+                        count += len(idx)
+                elif cfg.sequence_algorithm == "dm" and algo == "skipgram":
+                    # DM with skip-gram elements: label also predicts words
+                    if len(idx):
+                        buf_inputs.append(np.full(len(idx), label_idx, np.int32))
+                        buf_targets.append(idx)
+                        count += len(idx)
+            if count >= cfg.batch_size:
+                yield flush()
+        tail = flush()
+        if tail is not None:
+            yield tail
+
+    # --------------------------------------------------------- batch apply
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _pad(self, arr: np.ndarray, B: int, fill=0):
+        pad = B - len(arr)
+        if pad <= 0:
+            return arr
+        pad_block = np.full((pad,) + arr.shape[1:], fill, arr.dtype)
+        return np.concatenate([arr, pad_block], 0)
+
+    def _apply_batch(self, batch, lr: float) -> None:
+        cfg = self.config
+        lk = self.lookup
+        n = len(batch["targets"])
+        if n == 0:
+            return
+        # pad to the fixed batch size so XLA compiles one program shape
+        B = max(cfg.batch_size, int(2 ** math.ceil(math.log2(max(n, 1)))))
+        mask = self._pad(np.ones(n, np.float32), B)
+        targets = jnp.asarray(self._pad(batch["targets"], B))
+        lr = jnp.float32(lr)
+        if batch["kind"] == "sg":
+            inputs = jnp.asarray(self._pad(batch["inputs"], B))
+            if cfg.negative > 0:
+                negs = lk.sample_negatives(self._next_key(), (B, cfg.negative))
+                lk.syn0, lk.syn1neg, loss = learning.sg_ns_step(
+                    lk.syn0, lk.syn1neg, inputs, targets, negs,
+                    jnp.asarray(mask), lr)
+                self.cum_loss += float(loss)
+            if cfg.use_hierarchic_softmax:
+                pts = jnp.asarray(self._points)[targets]
+                cds = jnp.asarray(self._codes)[targets]
+                ln = jnp.asarray(self._code_lengths)[targets]
+                code_mask = (jnp.arange(self._codes.shape[1])[None, :]
+                             < ln[:, None]).astype(jnp.float32)
+                lk.syn0, lk.syn1, loss = learning.sg_hs_step(
+                    lk.syn0, lk.syn1, inputs, pts, cds, code_mask,
+                    jnp.asarray(mask), lr)
+                self.cum_loss += float(loss)
+        else:  # cbow
+            C = batch["contexts"].shape[1] if len(batch["contexts"]) else 2 * cfg.window
+            ctx = jnp.asarray(self._pad(batch["contexts"], B, fill=-1))
+            ctx_mask = (ctx >= 0).astype(jnp.float32)
+            if cfg.negative > 0:
+                negs = lk.sample_negatives(self._next_key(), (B, cfg.negative))
+                lk.syn0, lk.syn1neg, loss = learning.cbow_ns_step(
+                    lk.syn0, lk.syn1neg, ctx, ctx_mask, targets, negs,
+                    jnp.asarray(mask), lr)
+                self.cum_loss += float(loss)
+            if cfg.use_hierarchic_softmax:
+                pts = jnp.asarray(self._points)[targets]
+                cds = jnp.asarray(self._codes)[targets]
+                ln = jnp.asarray(self._code_lengths)[targets]
+                code_mask = (jnp.arange(self._codes.shape[1])[None, :]
+                             < ln[:, None]).astype(jnp.float32)
+                lk.syn0, lk.syn1, loss = learning.cbow_hs_step(
+                    lk.syn0, lk.syn1, ctx, ctx_mask, pts, cds, code_mask,
+                    jnp.asarray(mask), lr)
+                self.cum_loss += float(loss)
+
+    # ------------------------------------------------- WordVectors surface
+    @property
+    def syn0(self):
+        return self.lookup.syn0
+
+    def vocab_cache(self) -> VocabCache:
+        return self.vocab

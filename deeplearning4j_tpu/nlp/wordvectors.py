@@ -1,0 +1,96 @@
+"""WordVectors query surface: similarity / wordsNearest / arithmetic.
+
+Reference: ``models/embeddings/wordvectors/WordVectors.java`` +
+``models/embeddings/reader/impl/BasicModelUtils.java`` (cosine
+``wordsNearest``, ``wordsNearestSum``, similarity).
+
+TPU redesign: nearest-neighbour queries are one normalised matmul + top-k on
+device (``jax.lax.top_k``) instead of the reference's per-row host loops.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class WordVectors:
+    """Mixin over ``self.lookup`` (InMemoryLookupTable) + ``self.vocab``."""
+
+    # subclasses provide: self.lookup, self.vocab
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.contains_word(word)
+
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        return self.lookup.vector(word)
+
+    def get_word_vector_matrix(self, words: Sequence[str]) -> np.ndarray:
+        idx = [self.vocab.index_of(w) for w in words]
+        if any(i < 0 for i in idx):
+            missing = [w for w, i in zip(words, idx) if i < 0]
+            raise KeyError(f"Words not in vocab: {missing}")
+        return np.asarray(self.lookup.syn0[jnp.asarray(idx)])
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        if va is None or vb is None:
+            return float("nan")
+        denom = (np.linalg.norm(va) * np.linalg.norm(vb))
+        if denom == 0:
+            return 0.0
+        return float(np.dot(va, vb) / denom)
+
+    def _normed_syn0(self) -> jax.Array:
+        syn0 = self.lookup.syn0
+        return syn0 / jnp.maximum(jnp.linalg.norm(syn0, axis=1, keepdims=True), 1e-12)
+
+    def words_nearest(self, positive, negative=(), top_n: int = 10) -> List[str]:
+        """Cosine nearest words to (Σ positive − Σ negative); query words are
+        excluded from the result (reference BasicModelUtils semantics).
+        ``positive`` may be a single word, a list of words, or a raw vector."""
+        exclude = set()
+        if isinstance(positive, str):
+            positive = [positive]
+        if isinstance(positive, (list, tuple)) and positive and isinstance(positive[0], str):
+            vecs = [self.get_word_vector(w) for w in positive]
+            exclude.update(positive)
+            if any(v is None for v in vecs):
+                return []
+            query = np.sum(vecs, axis=0)
+        else:
+            query = np.asarray(positive)
+        for w in (negative if not isinstance(negative, str) else [negative]):
+            v = self.get_word_vector(w)
+            exclude.add(w)
+            if v is not None:
+                query = query - v
+        qn = query / max(np.linalg.norm(query), 1e-12)
+        sims = self._normed_syn0() @ jnp.asarray(qn, jnp.float32)
+        k = min(top_n + len(exclude), int(sims.shape[0]))
+        _, top_idx = jax.lax.top_k(sims, k)
+        out = []
+        for i in np.asarray(top_idx):
+            label = self.vocab.element_at_index(int(i)).label
+            if label in exclude:
+                continue
+            out.append(label)
+            if len(out) == top_n:
+                break
+        return out
+
+    def words_nearest_sum(self, positive, negative=(), top_n: int = 10) -> List[str]:
+        return self.words_nearest(positive, negative, top_n)
+
+    def similar_words_in_vocab_to(self, word: str, accuracy: float) -> List[str]:
+        v = self.get_word_vector(word)
+        if v is None:
+            return []
+        qn = v / max(np.linalg.norm(v), 1e-12)
+        sims = np.asarray(self._normed_syn0() @ jnp.asarray(qn, jnp.float32))
+        out = [self.vocab.element_at_index(i).label
+               for i in np.nonzero(sims >= accuracy)[0]]
+        return [w for w in out if w != word]

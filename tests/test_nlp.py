@@ -1,0 +1,280 @@
+"""NLP stack tests.
+
+Reference test models: ``Word2VecTests.java`` (wordsNearest sanity on a small
+corpus), tokenizer/iterator suites (``BasicLineIteratorTest`` etc.),
+``GloveTest``, ParagraphVectors label-inference tests, Huffman invariants.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    BasicLineIterator,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    LabelledDocument,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    Sequence,
+    SequenceVectors,
+    TfidfVectorizer,
+    VectorsConfiguration,
+    VocabCache,
+    VocabConstructor,
+    VocabWord,
+    Word2Vec,
+    WordVectorSerializer,
+    build_huffman,
+    codes_matrix,
+)
+
+
+# ------------------------------------------------------------- corpus fixture
+
+def synthetic_corpus(n=300, seed=7):
+    """Two topic clusters with strong co-occurrence structure: weather words
+    co-occur, finance words co-occur, never across."""
+    rs = np.random.RandomState(seed)
+    weather = ["rain", "snow", "storm", "cloud", "wind", "sun"]
+    finance = ["bank", "money", "stock", "market", "trade", "price"]
+    sentences = []
+    for _ in range(n):
+        topic = weather if rs.rand() < 0.5 else finance
+        words = rs.choice(topic, size=6, replace=True)
+        sentences.append(" ".join(words))
+    return sentences
+
+
+# -------------------------------------------------------------- tokenization
+
+def test_default_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.create("Hello, World! 42 times.").tokens()
+    assert toks == ["hello", "world", "times"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(1, 2)
+    toks = tf.create("a b c").tokens()
+    assert "a" in toks and "a b" in toks and "b c" in toks
+
+
+def test_basic_line_iterator(tmp_path):
+    p = tmp_path / "corpus.txt"
+    p.write_text("line one\nline two\nline three\n")
+    it = BasicLineIterator(str(p))
+    assert list(it) == ["line one", "line two", "line three"]
+    it.reset()
+    assert it.next_sentence() == "line one"
+
+
+# ------------------------------------------------------------------- vocab
+
+def test_vocab_constructor_counts_and_min_freq():
+    seqs = []
+    for words in (["a", "b", "a"], ["a", "c"]):
+        s = Sequence()
+        for w in words:
+            s.add_element(VocabWord(label=w))
+        seqs.append(s)
+    cache = VocabConstructor(min_element_frequency=2).build_vocab(seqs)
+    assert cache.contains_word("a")
+    assert not cache.contains_word("b")  # freq 1 < 2 pruned
+    assert cache.word_frequency("a") == 3
+
+
+def test_huffman_invariants():
+    cache = VocabCache()
+    freqs = {"the": 100, "of": 50, "cat": 10, "dog": 8, "zebu": 1}
+    for w, f in freqs.items():
+        cache.add_token(VocabWord(label=w, element_frequency=f))
+    cache.finalize_vocab()
+    build_huffman(cache)
+    words = cache.vocab_words()
+    # prefix-free: no code is a prefix of another
+    codes = {tuple(w.codes) for w in words}
+    assert len(codes) == len(words)
+    for c1 in codes:
+        for c2 in codes:
+            if c1 != c2:
+                assert c1 != c2[:len(c1)]
+    # frequent words get codes no longer than rare words
+    assert len(cache.word_for("the").codes) <= len(cache.word_for("zebu").codes)
+    # dense matrices align
+    cds, pts, lens = codes_matrix(cache)
+    assert cds.shape == pts.shape
+    w = cache.word_for("cat")
+    assert list(cds[w.index][:lens[w.index]]) == w.codes
+
+
+# ---------------------------------------------------------------- word2vec
+
+def fit_w2v(sentences, hs=True, negative=0, algo="skipgram", seed=1):
+    # NB small-vocab corpus + collision-mean kernels: fewer effective row
+    # updates per batch, compensated by a higher lr + smaller batches
+    w2v = (Word2Vec.Builder()
+           .iterate(sentences)
+           .layer_size(32)
+           .window_size(3)
+           .min_word_frequency(2)
+           .use_hierarchic_softmax(hs)
+           .negative_sample(negative)
+           .elements_learning_algorithm(algo)
+           .learning_rate(0.2)
+           .epochs(12)
+           .seed(seed)
+           .batch_size(64)
+           .build())
+    return w2v.fit()
+
+
+def check_cluster_structure(model):
+    weather = ["rain", "snow", "storm", "cloud"]
+    finance = ["bank", "money", "stock", "market"]
+    within = np.mean([model.similarity(a, b)
+                      for a in weather for b in weather if a != b])
+    across = np.mean([model.similarity(a, b)
+                      for a in weather for b in finance])
+    assert within > across + 0.15, f"within={within:.3f} across={across:.3f}"
+
+
+def test_word2vec_skipgram_hs_learns_structure():
+    model = fit_w2v(synthetic_corpus(), hs=True, negative=0)
+    check_cluster_structure(model)
+    near = model.words_nearest("rain", top_n=4)
+    assert len(set(near) & {"snow", "storm", "cloud", "wind", "sun"}) >= 3
+
+
+def test_word2vec_skipgram_ns_learns_structure():
+    model = fit_w2v(synthetic_corpus(), hs=False, negative=5)
+    check_cluster_structure(model)
+
+
+def test_word2vec_cbow_learns_structure():
+    model = fit_w2v(synthetic_corpus(), hs=True, negative=0, algo="cbow")
+    check_cluster_structure(model)
+
+
+def test_word2vec_vocab_and_vectors():
+    model = fit_w2v(synthetic_corpus())
+    assert model.has_word("rain")
+    assert not model.has_word("notaword")
+    v = model.get_word_vector("rain")
+    assert v.shape == (32,)
+    assert abs(model.similarity("rain", "rain") - 1.0) < 1e-5
+
+
+# -------------------------------------------------------------- serializer
+
+def test_text_format_roundtrip(tmp_path):
+    model = fit_w2v(synthetic_corpus())
+    p = str(tmp_path / "vecs.txt")
+    WordVectorSerializer.write_word_vectors(model, p)
+    loaded = WordVectorSerializer.read_word_vectors(p)
+    np.testing.assert_allclose(loaded.get_word_vector("rain"),
+                               model.get_word_vector("rain"), atol=1e-5)
+    assert loaded.words_nearest("rain", top_n=3) == model.words_nearest("rain", top_n=3)
+
+
+def test_binary_format_roundtrip(tmp_path):
+    model = fit_w2v(synthetic_corpus())
+    p = str(tmp_path / "vecs.bin")
+    WordVectorSerializer.write_binary(model, p)
+    loaded = WordVectorSerializer.read_binary(p)
+    np.testing.assert_allclose(loaded.get_word_vector("storm"),
+                               model.get_word_vector("storm"), atol=1e-6)
+
+
+def test_full_model_zip_roundtrip(tmp_path):
+    model = fit_w2v(synthetic_corpus())
+    p = str(tmp_path / "model.zip")
+    WordVectorSerializer.write_full_model(model, p)
+    loaded = WordVectorSerializer.read_full_model(p)
+    np.testing.assert_allclose(np.asarray(loaded.lookup.syn0),
+                               np.asarray(model.lookup.syn0), atol=1e-6)
+    assert loaded.vocab.word_frequency("rain") == model.vocab.word_frequency("rain")
+    # huffman codes survive
+    assert loaded.vocab.word_for("rain").codes == model.vocab.word_for("rain").codes
+
+
+# ----------------------------------------------------------------- glove
+
+def test_glove_learns_structure():
+    glove = (Glove.Builder()
+             .iterate(synthetic_corpus(400))
+             .layer_size(24)
+             .window_size(4)
+             .epochs(25)
+             .learning_rate(0.1)
+             .min_word_frequency(2)
+             .seed(3)
+             .build())
+    glove.fit()
+    weather = ["rain", "snow", "storm"]
+    finance = ["bank", "money", "stock"]
+    within = np.mean([glove.similarity(a, b)
+                      for a in weather for b in weather if a != b])
+    across = np.mean([glove.similarity(a, b)
+                      for a in weather for b in finance])
+    assert within > across + 0.1, f"within={within:.3f} across={across:.3f}"
+
+
+# --------------------------------------------------------- paragraph vectors
+
+def test_paragraph_vectors_labels_cluster():
+    rs = np.random.RandomState(11)
+    weather = ["rain", "snow", "storm", "cloud", "wind", "sun"]
+    finance = ["bank", "money", "stock", "market", "trade", "price"]
+    docs = []
+    for i in range(60):
+        topic, tag = (weather, "W") if i % 2 == 0 else (finance, "F")
+        content = " ".join(rs.choice(topic, size=8))
+        docs.append(LabelledDocument(content=content, labels=[f"{tag}_{i}"]))
+    pv = (ParagraphVectors.Builder()
+          .iterate(docs)
+          .layer_size(24)
+          .window_size(3)
+          .min_word_frequency(1)
+          .use_hierarchic_softmax(True)
+          .learning_rate(0.2)
+          .epochs(20)
+          .seed(5)
+          .batch_size(64)
+          .build())
+    pv.fit()
+    # label vectors of same-topic docs are closer than cross-topic
+    w_labels = [f"W_{i}" for i in range(0, 20, 2)]
+    f_labels = [f"F_{i}" for i in range(1, 20, 2)]
+    within = np.mean([pv.similarity(a, b) for a in w_labels for b in w_labels if a != b])
+    across = np.mean([pv.similarity(a, b) for a in w_labels for b in f_labels])
+    assert within > across, f"within={within:.3f} across={across:.3f}"
+    # inference maps unseen text near the right cluster
+    pred = pv.predict("rain snow storm wind cloud sun rain storm")
+    assert pred.startswith("W_"), pred
+
+
+# ------------------------------------------------------------------- bow
+
+def test_bag_of_words_counts():
+    bow = BagOfWordsVectorizer()
+    mat = bow.fit_transform(["a b a", "b c"])
+    ia, ib = bow.vocab.index_of("a"), bow.vocab.index_of("b")
+    assert mat[0, ia] == 2 and mat[0, ib] == 1
+    assert mat.shape == (2, 3)
+
+
+def test_tfidf_downweights_common_terms():
+    docs = ["a b", "a c", "a d"]
+    tv = TfidfVectorizer()
+    mat = tv.fit_transform(docs)
+    ia = tv.vocab.index_of("a")
+    ib = tv.vocab.index_of("b")
+    # 'a' appears in every doc -> idf 0
+    assert mat[0, ia] == pytest.approx(0.0)
+    assert mat[0, ib] > 0
