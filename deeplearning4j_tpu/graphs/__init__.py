@@ -1,0 +1,26 @@
+"""Graph embeddings module (≙ deeplearning4j-graph).
+
+Graph API + loaders + random walks + DeepWalk; embedding training rides the
+shared SequenceVectors engine (walks are just sequences of vertex labels),
+replacing the reference's bespoke ``InMemoryGraphLookupTable``/``BinaryTree``
+Hogwild path with the same batched TPU kernels as Word2Vec.
+"""
+
+from deeplearning4j_tpu.graphs.api import Edge, Graph, Vertex
+from deeplearning4j_tpu.graphs.loaders import (
+    load_delimited_edges,
+    load_delimited_vertices,
+    load_weighted_edges,
+)
+from deeplearning4j_tpu.graphs.walks import (
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+    generate_walks,
+)
+from deeplearning4j_tpu.graphs.deepwalk import DeepWalk
+
+__all__ = [
+    "Edge", "Graph", "Vertex", "load_delimited_edges",
+    "load_delimited_vertices", "load_weighted_edges", "RandomWalkIterator",
+    "WeightedRandomWalkIterator", "generate_walks", "DeepWalk",
+]

@@ -1,0 +1,94 @@
+"""DeepWalk: random-walk + skip-gram vertex embeddings.
+
+Reference: ``graph/models/deepwalk/DeepWalk.java`` (Builder: vectorSize,
+windowSize, learningRate, walkLength, walksPerVertex; fit(graph) generates
+walks and trains skip-gram over them with a ``GraphHuffman`` tree +
+``InMemoryGraphLookupTable``), ``models/GraphVectors.java`` query surface
+(similarity, verticesNearest).
+
+TPU redesign: walks come from the vectorised ``generate_walks`` sweep and
+train through the SAME batched SequenceVectors engine as Word2Vec —
+hierarchical softmax over a Huffman tree on vertex visit-frequencies (the
+GraphHuffman equivalent is the shared ``vocab.build_huffman``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graphs.api import Graph
+from deeplearning4j_tpu.graphs.walks import generate_walks
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors, VectorsConfiguration
+from deeplearning4j_tpu.nlp.vocab import Sequence, VocabWord
+
+
+class DeepWalk:
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, walk_length: int = 40,
+                 walks_per_vertex: int = 10, epochs: int = 1,
+                 negative: int = 0, use_hierarchic_softmax: bool = True,
+                 batch_size: int = 512, seed: int = 12345):
+        self.vector_size = vector_size
+        self.window_size = window_size
+        self.learning_rate = learning_rate
+        self.walk_length = walk_length
+        self.walks_per_vertex = walks_per_vertex
+        self.epochs = epochs
+        self.negative = negative
+        self.use_hierarchic_softmax = use_hierarchic_softmax
+        self.batch_size = batch_size
+        self.seed = seed
+        self._sv: Optional[SequenceVectors] = None
+        self.graph: Optional[Graph] = None
+
+    # ---------------------------------------------------------------- fit
+    def fit(self, graph: Graph) -> "DeepWalk":
+        self.graph = graph
+        walks = generate_walks(graph, self.walk_length, self.walks_per_vertex,
+                               seed=self.seed)
+
+        def sequences():
+            for row in walks:
+                seq = Sequence()
+                for v in row:
+                    seq.add_element(VocabWord(label=str(int(v))))
+                yield seq
+
+        cfg = VectorsConfiguration(
+            layer_size=self.vector_size,
+            window=self.window_size,
+            learning_rate=self.learning_rate,
+            negative=self.negative,
+            use_hierarchic_softmax=self.use_hierarchic_softmax,
+            min_word_frequency=1,
+            epochs=self.epochs,
+            batch_size=self.batch_size,
+            seed=self.seed,
+        )
+        self._sv = SequenceVectors(cfg, sequences)
+        self._sv.fit()
+        return self
+
+    # -------------------------------------------------- GraphVectors query
+    @property
+    def lookup(self):
+        return self._sv.lookup
+
+    @property
+    def vocab(self):
+        return self._sv.vocab
+
+    def num_vertices(self) -> int:
+        return self.graph.num_vertices if self.graph else 0
+
+    def vertex_vector(self, idx: int) -> np.ndarray:
+        return self._sv.get_word_vector(str(idx))
+
+    def similarity(self, a: int, b: int) -> float:
+        """≙ ``GraphVectorsImpl.similarity``."""
+        return self._sv.similarity(str(a), str(b))
+
+    def vertices_nearest(self, idx: int, top_n: int = 10) -> List[int]:
+        return [int(w) for w in self._sv.words_nearest(str(idx), top_n=top_n)]

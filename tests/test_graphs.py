@@ -1,0 +1,157 @@
+"""Graph module tests (≙ TestGraphLoading / TestGraph / TestDeepWalk)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graphs import (
+    DeepWalk,
+    Graph,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+    generate_walks,
+    load_delimited_edges,
+    load_delimited_vertices,
+    load_weighted_edges,
+)
+
+
+def ring_graph(n=10):
+    g = Graph(n)
+    for i in range(n):
+        g.add_edge(i, (i + 1) % n)
+    return g
+
+
+def two_cliques(k=5):
+    """Two k-cliques joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for base in (0, k):
+        for i in range(k):
+            for j in range(i + 1, k):
+                g.add_edge(base + i, base + j)
+    g.add_edge(0, k)  # bridge
+    return g
+
+
+# ------------------------------------------------------------------- api
+
+def test_graph_basics():
+    g = ring_graph(5)
+    assert g.num_vertices == 5
+    assert g.degree(0) == 2            # undirected: 0-1 and 4-0
+    assert set(g.neighbors(0)) == {1, 4}
+    assert g.num_edges() == 10         # 5 undirected edges, both directions
+
+
+def test_directed_edges():
+    g = Graph(3)
+    g.add_edge(0, 1, directed=True)
+    assert g.neighbors(0) == [1]
+    assert g.neighbors(1) == []
+
+
+def test_neighbor_table():
+    g = ring_graph(4)
+    table, weights, deg = g.neighbor_table()
+    assert table.shape[0] == 4
+    assert (deg == 2).all()
+    assert set(table[0][:2]) == {1, 3}
+
+
+# ---------------------------------------------------------------- loaders
+
+def test_edge_list_loading(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("# comment\n0,1\n1,2\n2,0\n")
+    g = load_delimited_edges(str(p), 3)
+    assert g.num_edges() == 6
+    assert set(g.neighbors(0)) == {1, 2}
+
+
+def test_weighted_edge_loading(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("0,1,0.5\n1,2,2.0\n")
+    g = load_weighted_edges(str(p), 3)
+    assert g.edges_out(0)[0].weight == 0.5
+
+
+def test_vertex_loading(tmp_path):
+    p = tmp_path / "verts.txt"
+    p.write_text("0,zero\n1,one\n")
+    vs = load_delimited_vertices(str(p))
+    assert vs[0].value == "zero" and vs[1].idx == 1
+
+
+# ------------------------------------------------------------------ walks
+
+def test_random_walk_iterator_structure():
+    g = ring_graph(6)
+    it = RandomWalkIterator(g, walk_length=8, seed=1)
+    walks = list(it)
+    assert len(walks) == 6
+    for i, w in enumerate(walks):
+        assert w[0] == i and len(w) == 9
+        for a, b in zip(w, w[1:]):   # every hop follows an edge
+            assert b in g.neighbors(a)
+
+
+def test_weighted_walk_prefers_heavy_edges():
+    g = Graph(3)
+    g.add_edge(0, 1, weight=100.0)
+    g.add_edge(0, 2, weight=0.01)
+    it = WeightedRandomWalkIterator(g, walk_length=1, seed=0)
+    hops = [it._walk_from(0)[1] for _ in range(50)]
+    assert hops.count(1) > 40
+
+
+def test_dead_end_self_loops():
+    g = Graph(2)
+    g.add_edge(0, 1, directed=True)
+    it = RandomWalkIterator(g, walk_length=3, seed=0)
+    w = it._walk_from(0)
+    assert w == [0, 1, 1, 1]
+
+
+def test_generate_walks_batch():
+    g = ring_graph(6)
+    walks = generate_walks(g, walk_length=5, walks_per_vertex=3, seed=2)
+    assert walks.shape == (18, 6)
+    for w in walks:
+        for a, b in zip(w, w[1:]):
+            assert b in g.neighbors(int(a))
+
+
+def test_generate_walks_weighted():
+    g = Graph(3)
+    g.add_edge(0, 1, weight=100.0)
+    g.add_edge(0, 2, weight=0.01)
+    walks = generate_walks(g, walk_length=1, walks_per_vertex=200, seed=0,
+                           weighted=True)
+    first_hops = walks[walks[:, 0] == 0][:, 1]
+    assert (first_hops == 1).mean() > 0.9
+
+
+# --------------------------------------------------------------- deepwalk
+
+def test_deepwalk_learns_community_structure():
+    g = two_cliques(5)
+    dw = DeepWalk(vector_size=16, window_size=3, walk_length=20,
+                  walks_per_vertex=8, epochs=5, learning_rate=0.2,
+                  batch_size=64, seed=4)
+    dw.fit(g)
+    # same-clique vertices more similar than cross-clique
+    within = np.mean([dw.similarity(a, b)
+                      for a in range(1, 5) for b in range(1, 5) if a != b])
+    across = np.mean([dw.similarity(a, b)
+                      for a in range(1, 5) for b in range(6, 10)])
+    assert within > across, f"within={within:.3f} across={across:.3f}"
+    near = dw.vertices_nearest(1, top_n=3)
+    assert len(set(near) & {0, 2, 3, 4}) >= 2
+
+
+def test_deepwalk_vertex_vector_shape():
+    g = ring_graph(8)
+    dw = DeepWalk(vector_size=12, walk_length=10, walks_per_vertex=2,
+                  seed=1).fit(g)
+    assert dw.vertex_vector(0).shape == (12,)
+    assert dw.num_vertices() == 8
