@@ -1,0 +1,9 @@
+"""Clustering + space-partition trees + t-SNE (≙ deeplearning4j-core
+``clustering/`` and ``plot/``)."""
+
+from deeplearning4j_tpu.clustering.kmeans import Cluster, ClusterSet, KMeansClustering
+from deeplearning4j_tpu.clustering.trees import KDTree, QuadTree, SpTree, VPTree
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
+
+__all__ = ["Cluster", "ClusterSet", "KMeansClustering", "KDTree", "QuadTree",
+           "SpTree", "VPTree", "BarnesHutTsne", "Tsne"]

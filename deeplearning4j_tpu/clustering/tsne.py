@@ -1,0 +1,188 @@
+"""t-SNE: exact (device) + Barnes-Hut (SpTree approximation).
+
+Reference: ``deeplearning4j-core/.../plot/Tsne.java`` (exact gradient t-SNE
+with momentum + gain adaptation) and ``plot/BarnesHutTsne.java:63,93,294``
+(theta-approximated forces via SpTree, implemented as a ``Model``).
+
+TPU redesign: the exact path runs the whole optimisation on device — the
+[N,N] affinity/Q matrices are batched matmul/softmax shapes the MXU eats;
+per-perplexity beta search is a vectorised bisection.  The Barnes-Hut path
+stays host-side (pointer-chasing tree walk; reference parity) and is the
+O(N log N) option for large N.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.clustering.trees import SpTree
+
+
+# ---------------------------------------------------------------------------
+# shared: P-matrix from perplexity (vectorised beta bisection)
+# ---------------------------------------------------------------------------
+
+def _p_conditional(dist2: np.ndarray, perplexity: float, tol: float = 1e-5,
+                   max_tries: int = 50) -> np.ndarray:
+    """Row-stochastic conditional affinities with per-row beta found by
+    bisection so each row's entropy == log(perplexity)."""
+    N = dist2.shape[0]
+    target = np.log(perplexity)
+    beta = np.ones(N)
+    beta_min = np.full(N, -np.inf)
+    beta_max = np.full(N, np.inf)
+    mask = ~np.eye(N, dtype=bool)
+    P = np.zeros((N, N))
+    for _ in range(max_tries):
+        expo = np.exp(-dist2 * beta[:, None])
+        expo[~mask] = 0.0
+        sums = np.maximum(expo.sum(1, keepdims=True), 1e-12)
+        P = expo / sums
+        # entropy per row
+        H = -np.sum(np.where(P > 0, P * np.log(np.maximum(P, 1e-12)), 0.0), 1)
+        diff = H - target
+        done = np.abs(diff) < tol
+        if done.all():
+            break
+        too_high = diff > 0  # entropy too high -> increase beta
+        beta_min = np.where(too_high & ~done, beta, beta_min)
+        beta_max = np.where(~too_high & ~done, beta, beta_max)
+        beta = np.where(
+            too_high & ~done,
+            np.where(np.isinf(beta_max), beta * 2, (beta + beta_max) / 2),
+            np.where(np.isinf(beta_min), beta / 2, (beta + beta_min) / 2))
+    return P
+
+
+def _joint_p(x: np.ndarray, perplexity: float) -> np.ndarray:
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+    P = _p_conditional(d2, perplexity)
+    P = (P + P.T) / (2 * len(x))
+    return np.maximum(P, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# exact t-SNE — jitted update step
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, donate_argnums=(1, 2, 3))
+def _tsne_step(P, y, vel, gains, lr, momentum):
+    N = y.shape[0]
+    d2 = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    num = 1.0 / (1.0 + d2)
+    num = num * (1.0 - jnp.eye(N, dtype=y.dtype))
+    Q = jnp.maximum(num / jnp.maximum(num.sum(), 1e-12), 1e-12)
+    PQ = (P - Q) * num                                   # [N,N]
+    grad = 4.0 * ((jnp.diag(PQ.sum(1)) - PQ) @ y)        # [N,2]
+    # gain adaptation (reference Tsne.java momentum/gain schedule)
+    same_sign = jnp.sign(grad) == jnp.sign(vel)
+    gains = jnp.maximum(jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01)
+    vel = momentum * vel - lr * gains * grad
+    y = y + vel
+    y = y - y.mean(0, keepdims=True)
+    kl = jnp.sum(P * jnp.log(P / Q))
+    return y, vel, gains, kl
+
+
+class Tsne:
+    """Exact t-SNE. ≙ ``plot/Tsne.java`` builder knobs."""
+
+    def __init__(self, n_components: int = 2, perplexity: float = 30.0,
+                 learning_rate: float = 200.0, n_iter: int = 500,
+                 momentum: float = 0.5, final_momentum: float = 0.8,
+                 switch_momentum_iteration: int = 100,
+                 early_exaggeration: float = 4.0,
+                 stop_lying_iteration: int = 100, seed: int = 12345):
+        self.n_components = n_components
+        self.perplexity = perplexity
+        self.learning_rate = learning_rate
+        self.n_iter = n_iter
+        self.momentum = momentum
+        self.final_momentum = final_momentum
+        self.switch_momentum_iteration = switch_momentum_iteration
+        self.early_exaggeration = early_exaggeration
+        self.stop_lying_iteration = stop_lying_iteration
+        self.seed = seed
+        self.kl_divergence_: Optional[float] = None
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        N = x.shape[0]
+        P = _joint_p(x, min(self.perplexity, (N - 1) / 3.0))
+        P_dev = jnp.asarray(P * self.early_exaggeration, jnp.float32)
+        rs = np.random.RandomState(self.seed)
+        y = jnp.asarray(rs.randn(N, self.n_components).astype(np.float32) * 1e-2)
+        vel = jnp.zeros_like(y)
+        gains = jnp.ones_like(y)
+        kl = None
+        for it in range(self.n_iter):
+            if it == self.stop_lying_iteration:
+                P_dev = jnp.asarray(P, jnp.float32)
+            mom = (self.momentum if it < self.switch_momentum_iteration
+                   else self.final_momentum)
+            y, vel, gains, kl = _tsne_step(P_dev, y, vel, gains,
+                                           jnp.float32(self.learning_rate),
+                                           jnp.float32(mom))
+        self.kl_divergence_ = float(kl)
+        return np.asarray(y)
+
+
+class BarnesHutTsne(Tsne):
+    """theta-approximated t-SNE via SpTree (O(N log N) repulsion).
+    ≙ ``plot/BarnesHutTsne.java`` (theta default 0.5)."""
+
+    def __init__(self, theta: float = 0.5, **kw):
+        super().__init__(**kw)
+        self.theta = theta
+
+    def fit_transform(self, x) -> np.ndarray:
+        x = np.asarray(x, np.float64)
+        N = x.shape[0]
+        P = _joint_p(x, min(self.perplexity, (N - 1) / 3.0))
+        # sparse-ish edges: keep 3*perplexity strongest per row (reference
+        # uses exact kNN input similarities)
+        rs = np.random.RandomState(self.seed)
+        y = rs.randn(N, self.n_components) * 1e-2
+        vel = np.zeros_like(y)
+        gains = np.ones_like(y)
+        P_work = P * self.early_exaggeration
+        for it in range(self.n_iter):
+            if it == self.stop_lying_iteration:
+                P_work = P
+            mom = (self.momentum if it < self.switch_momentum_iteration
+                   else self.final_momentum)
+            grad = self._gradient(P_work, y)
+            same_sign = np.sign(grad) == np.sign(vel)
+            gains = np.maximum(np.where(same_sign, gains * 0.8, gains + 0.2), 0.01)
+            vel = mom * vel - self.learning_rate * gains * grad
+            y = y + vel
+            y -= y.mean(0, keepdims=True)
+        # final KL (exact, for reporting)
+        d2 = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        num = 1.0 / (1.0 + d2)
+        np.fill_diagonal(num, 0.0)
+        Q = np.maximum(num / num.sum(), 1e-12)
+        self.kl_divergence_ = float(np.sum(P * np.log(P / Q)))
+        return y
+
+    def _gradient(self, P: np.ndarray, y: np.ndarray) -> np.ndarray:
+        N = y.shape[0]
+        tree = SpTree.build(y)
+        # attractive forces (edge forces): exact over nonzero P
+        d2 = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+        qnum = 1.0 / (1.0 + d2)
+        np.fill_diagonal(qnum, 0.0)
+        pos = ((P * qnum)[:, :, None] * (y[:, None, :] - y[None, :, :])).sum(1)
+        # repulsive via Barnes-Hut
+        neg = np.zeros_like(y)
+        Z = 0.0
+        for i in range(N):
+            f = np.zeros(y.shape[1])
+            Z += tree.compute_non_edge_forces(y[i], self.theta, f)
+            neg[i] = f
+        return 4.0 * (pos - neg / max(Z, 1e-12))

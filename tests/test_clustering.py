@@ -1,0 +1,161 @@
+"""Clustering / trees / t-SNE tests (≙ KMeans, KDTree, VPTree, SpTree and
+Tsne/BarnesHutTsne suites in deeplearning4j-core)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BarnesHutTsne,
+    KDTree,
+    KMeansClustering,
+    QuadTree,
+    SpTree,
+    Tsne,
+    VPTree,
+)
+
+
+def blobs(n_per=40, centers=((0, 0), (10, 10), (-10, 10)), seed=0, scale=0.5):
+    rs = np.random.RandomState(seed)
+    pts, labels = [], []
+    for k, c in enumerate(centers):
+        pts.append(rs.randn(n_per, len(c)) * scale + np.asarray(c))
+        labels += [k] * n_per
+    return np.concatenate(pts).astype(np.float32), np.array(labels)
+
+
+# ---------------------------------------------------------------- k-means
+
+def test_kmeans_recovers_blobs():
+    x, labels = blobs()
+    cs = KMeansClustering(k=3, seed=1).apply_to(x)
+    # each true cluster maps to exactly one k-means cluster
+    mapping = {}
+    for k in range(3):
+        assigned = cs.assignments[labels == k]
+        vals, counts = np.unique(assigned, return_counts=True)
+        assert counts.max() / counts.sum() > 0.95
+        mapping[k] = vals[counts.argmax()]
+    assert len(set(mapping.values())) == 3
+    assert cs.inertia < 200.0
+
+
+def test_kmeans_nearest_cluster_and_members():
+    x, _ = blobs()
+    cs = KMeansClustering(k=3, seed=1).apply_to(x)
+    c = cs.nearest_cluster([10, 10])
+    center = cs.centers[c]
+    assert np.linalg.norm(center - [10, 10]) < 1.0
+    assert sum(len(cl.point_indices) for cl in cs.clusters) == len(x)
+
+
+def test_kmeans_k_exceeds_points():
+    with pytest.raises(ValueError):
+        KMeansClustering(k=10).apply_to(np.zeros((3, 2), np.float32))
+
+
+# ------------------------------------------------------------------ trees
+
+def brute_knn(points, q, k):
+    d = np.linalg.norm(points - q, axis=1)
+    order = np.argsort(d)[:k]
+    return list(order), d[order]
+
+
+@pytest.mark.parametrize("tree_cls", [KDTree, VPTree])
+def test_tree_knn_matches_bruteforce(tree_cls):
+    rs = np.random.RandomState(3)
+    pts = rs.rand(200, 4)
+    tree = tree_cls(pts)
+    for _ in range(10):
+        q = rs.rand(4)
+        got = tree.knn(q, 5)
+        want_idx, want_d = brute_knn(pts, q, 5)
+        assert [i for i, _ in got] == want_idx
+        np.testing.assert_allclose([d for _, d in got], want_d, rtol=1e-9)
+
+
+def test_kdtree_nn():
+    pts = np.array([[0, 0], [1, 1], [5, 5]], float)
+    idx, d = KDTree(pts).nn([0.9, 0.9])
+    assert idx == 1 and d == pytest.approx(np.hypot(0.1, 0.1))
+
+
+def test_quadtree_counts_and_com():
+    rs = np.random.RandomState(0)
+    pts = rs.rand(50, 2)
+    qt = QuadTree.build(pts)
+    assert qt.n_points == 50
+    np.testing.assert_allclose(qt.com, pts.mean(0), atol=1e-9)
+
+
+def test_quadtree_duplicate_points_no_infinite_recursion():
+    pts = np.array([[0.5, 0.5]] * 5 + [[0.1, 0.1]])
+    qt = QuadTree.build(pts)
+    assert qt.n_points == 6
+
+
+def test_sptree_3d_and_forces():
+    rs = np.random.RandomState(1)
+    pts = rs.randn(60, 3)
+    sp = SpTree.build(pts)
+    assert sp.n_points == 60
+    np.testing.assert_allclose(sp.com, pts.mean(0), atol=1e-9)
+    # theta=0 (always recurse) must equal exact repulsion
+    target = pts[0]
+    f = np.zeros(3)
+    z = sp.compute_non_edge_forces(target, 0.0, f)
+    diff = target[None, :] - pts[1:]
+    q = 1.0 / (1.0 + (diff ** 2).sum(1))
+    z_exact = q.sum()
+    f_exact = ((q ** 2)[:, None] * diff).sum(0)
+    assert z == pytest.approx(z_exact, rel=1e-9)
+    np.testing.assert_allclose(f, f_exact, rtol=1e-9)
+
+
+# ------------------------------------------------------------------ t-SNE
+
+def separation_score(emb, labels):
+    """mean inter-class dist / mean intra-class dist."""
+    intra, inter = [], []
+    for i in range(0, len(emb), 7):
+        for j in range(i + 1, len(emb), 7):
+            d = np.linalg.norm(emb[i] - emb[j])
+            (intra if labels[i] == labels[j] else inter).append(d)
+    return np.mean(inter) / np.mean(intra)
+
+
+def test_exact_tsne_separates_blobs():
+    x, labels = blobs(n_per=30, scale=0.3)
+    ts = Tsne(perplexity=10, n_iter=300, learning_rate=100, seed=2)
+    emb = ts.fit_transform(x)
+    assert emb.shape == (90, 2)
+    assert np.isfinite(ts.kl_divergence_)
+    assert separation_score(emb, labels) > 2.0
+
+
+def test_barnes_hut_tsne_separates_blobs():
+    x, labels = blobs(n_per=25, scale=0.3)
+    ts = BarnesHutTsne(theta=0.5, perplexity=8, n_iter=250,
+                       learning_rate=100, seed=2)
+    emb = ts.fit_transform(x)
+    assert emb.shape == (75, 2)
+    assert separation_score(emb, labels) > 2.0
+
+
+def test_barnes_hut_theta0_close_to_exact_gradient():
+    x, _ = blobs(n_per=10, scale=0.3, seed=5)
+    P = np.full((30, 30), 1.0 / (30 * 29))
+    np.fill_diagonal(P, 0)
+    rs = np.random.RandomState(0)
+    y = rs.randn(30, 2) * 0.1
+    bh = BarnesHutTsne(theta=0.0, n_iter=1)
+    g_bh = bh._gradient(P, y)
+    # exact gradient
+    d2 = ((y[:, None, :] - y[None, :, :]) ** 2).sum(-1)
+    num = 1.0 / (1.0 + d2)
+    np.fill_diagonal(num, 0)
+    Q = np.maximum(num / num.sum(), 1e-12)
+    PQ = (P - Q) * num
+    g_exact = 4.0 * (np.diag(PQ.sum(1)) - PQ) @ y
+    np.testing.assert_allclose(g_bh, g_exact, atol=1e-6)
