@@ -1,0 +1,40 @@
+"""Observability / UI (≙ deeplearning4j-ui-parent): stats listeners, stats
+storage, declarative UI components, and an HTTP training dashboard."""
+
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartLine,
+    ChartScatter,
+    ChartStackedArea,
+    Component,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    StyleChart,
+    component_from_dict,
+)
+from deeplearning4j_tpu.ui.server import RemoteStatsListener, UIServer
+from deeplearning4j_tpu.ui.stats import (
+    FlowIterationListener,
+    HistogramIterationListener,
+    StatsInitializationReport,
+    StatsListener,
+    StatsReport,
+    StatsUpdateConfiguration,
+    device_memory_stats,
+)
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsStorage,
+)
+
+__all__ = [
+    "ChartHistogram", "ChartLine", "ChartScatter", "ChartStackedArea",
+    "Component", "ComponentDiv", "ComponentTable", "ComponentText",
+    "StyleChart", "component_from_dict", "RemoteStatsListener", "UIServer",
+    "FlowIterationListener", "HistogramIterationListener",
+    "StatsInitializationReport", "StatsListener", "StatsReport",
+    "StatsUpdateConfiguration", "device_memory_stats", "FileStatsStorage",
+    "InMemoryStatsStorage", "StatsStorage",
+]

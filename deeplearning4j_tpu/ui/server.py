@@ -1,0 +1,193 @@
+"""Training UI server + remote stats listener.
+
+Reference: ``deeplearning4j-ui/.../UiServer.java:25-33`` (Dropwizard/Jetty
+app: REST endpoints + static assets + live charts) and
+``deeplearning4j-ui-remote-iterationlisteners/.../RemoteFlowIterationListener
+.java`` (train cluster POSTs stats to a remote UI host).
+
+Redesign: stdlib ``http.server`` on a background thread; endpoints return
+JSON from a StatsStorage; a single self-contained HTML page renders score
+curves + histograms with inline SVG (no external JS, no CDN).  The remote
+listener POSTs StatsReport JSON to ``/collect``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deeplearning4j_tpu.optimize.listeners import IterationListener
+from deeplearning4j_tpu.ui.stats import StatsReport, StatsUpdateConfiguration
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+ body{font-family:sans-serif;margin:24px;background:#fafafa;color:#222}
+ h1{font-size:20px} h2{font-size:16px;margin-top:28px}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+       padding:12px;margin:12px 0;max-width:720px}
+ svg{background:#fff} table{border-collapse:collapse}
+ td,th{border:1px solid #ccc;padding:4px 8px;font-size:13px}
+</style></head>
+<body><h1>deeplearning4j_tpu training UI</h1><div id="root">loading…</div>
+<script>
+function poly(xs, ys, w, h, pad){
+  const xmin=Math.min(...xs), xmax=Math.max(...xs);
+  const ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const sx=x=>pad+(x-xmin)/Math.max(xmax-xmin,1e-9)*(w-2*pad);
+  const sy=y=>h-pad-(y-ymin)/Math.max(ymax-ymin,1e-9)*(h-2*pad);
+  return xs.map((x,i)=>`${sx(x).toFixed(1)},${sy(ys[i]).toFixed(1)}`).join(' ');
+}
+function lineChart(title, xs, ys){
+  const w=680,h=260,p=30;
+  return `<div class="card"><h2>${title}</h2>
+   <svg width="${w}" height="${h}">
+    <polyline fill="none" stroke="#1f77b4" stroke-width="1.5"
+      points="${poly(xs,ys,w,h,p)}"/>
+    <text x="${p}" y="14" font-size="11">last: ${ys[ys.length-1].toPrecision(5)}</text>
+   </svg></div>`;
+}
+function histChart(title, bins, counts){
+  const w=680,h=160,p=25; const maxc=Math.max(...counts,1);
+  const bw=(w-2*p)/counts.length;
+  const bars=counts.map((c,i)=>`<rect x="${(p+i*bw).toFixed(1)}"
+    y="${(h-p-(c/maxc)*(h-2*p)).toFixed(1)}" width="${(bw-1).toFixed(1)}"
+    height="${((c/maxc)*(h-2*p)).toFixed(1)}" fill="#2ca02c"/>`).join('');
+  return `<div class="card"><h2>${title}</h2>
+    <svg width="${w}" height="${h}">${bars}</svg></div>`;
+}
+async function refresh(){
+  const sessions = await (await fetch('train/sessions')).json();
+  let html='';
+  for(const sid of sessions){
+    const data = await (await fetch('train/overview?sid='+sid)).json();
+    html += `<h2>session ${sid}</h2>`;
+    if(data.iterations.length>1)
+      html += lineChart('score vs iteration', data.iterations, data.scores);
+    if(data.iteration_times.length>1)
+      html += lineChart('iteration time (ms)', data.iterations, data.iteration_times);
+    const latest = data.latest_histograms || {};
+    for(const k of Object.keys(latest).slice(0,8)){
+      html += histChart('param histogram: '+k, latest[k].bins, latest[k].counts);
+    }
+  }
+  document.getElementById('root').innerHTML = html || 'no sessions yet';
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class UIServer:
+    """≙ ``UiServer.java``: hosts the dashboard + REST + /collect ingest."""
+
+    def __init__(self, storage: Optional[StatsStorage] = None, port: int = 0):
+        self.storage = storage or InMemoryStatsStorage()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._requested_port = port
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> int:
+        storage = self.storage
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
+                if path in ("/", "/train", "/train/"):
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path.endswith("/train/sessions") or path == "/sessions":
+                    self._json(storage.list_session_ids())
+                elif path.endswith("/train/overview") or path == "/overview":
+                    sid = params.get("sid")
+                    ups = storage.get_updates(sid) if sid else []
+                    latest_hist = {}
+                    if ups and ups[-1].param_histograms:
+                        latest_hist = ups[-1].param_histograms
+                    self._json({
+                        "iterations": [u.iteration for u in ups],
+                        "scores": [u.score for u in ups],
+                        "iteration_times": [u.iteration_time_ms for u in ups],
+                        "latest_histograms": latest_hist,
+                    })
+                elif path.endswith("/train/memory"):
+                    sid = params.get("sid")
+                    ups = storage.get_updates(sid) if sid else []
+                    self._json([u.memory for u in ups])
+                else:
+                    self._json({"error": "not found", "path": path}, 404)
+
+            def do_POST(self):
+                if self.path.rstrip("/").endswith("/collect"):
+                    n = int(self.headers.get("Content-Length", 0))
+                    rep = StatsReport.from_json(self.rfile.read(n).decode())
+                    storage.put_update(rep)
+                    self._json({"ok": True})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self._requested_port),
+                                          Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class RemoteStatsListener(IterationListener):
+    """POSTs per-iteration StatsReports to a remote UI server.
+    ≙ ``RemoteFlowIterationListener.java`` (train host ≠ UI host)."""
+
+    def __init__(self, url: str, session_id: str = "remote",
+                 frequency: int = 1, timeout: float = 2.0):
+        self.url = url.rstrip("/") + "/collect"
+        self.session_id = session_id
+        self.frequency = frequency
+        self.timeout = timeout
+
+    def iteration_done(self, model, iteration: int) -> None:
+        if iteration % max(self.frequency, 1) != 0:
+            return
+        import time as _time
+
+        rep = StatsReport(session_id=self.session_id, iteration=iteration,
+                          timestamp=_time.time(),
+                          score=float(getattr(model, "score_value", float("nan"))))
+        data = rep.to_json().encode()
+        req = urllib.request.Request(
+            self.url, data=data, headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout)
+        except Exception:
+            pass  # UI down must never kill training (reference behavior)

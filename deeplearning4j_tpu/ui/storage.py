@@ -1,0 +1,130 @@
+"""Stats persistence + routing.
+
+Reference: ``deeplearning4j-ui-model/.../storage/{StatsStorage,
+StatsStorageRouter,Persistable}.java`` and ``storage/mapdb/MapDBStatsStorage
+.java`` — pluggable session stores with attach/listener fan-out.
+
+The MapDB file store becomes a JSONL append file (self-describing records,
+no native lib); in-memory store for tests/local UI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+from deeplearning4j_tpu.ui.stats import StatsInitializationReport, StatsReport
+
+
+class StatsStorage:
+    """≙ ``storage/StatsStorage.java`` (router+query surface)."""
+
+    def __init__(self):
+        self._listeners: List[Callable[[StatsReport], None]] = []
+        self._lock = threading.Lock()
+
+    # -- router surface
+    def put_init_report(self, rep: StatsInitializationReport) -> None:
+        raise NotImplementedError
+
+    def put_update(self, rep: StatsReport) -> None:
+        raise NotImplementedError
+
+    def add_listener(self, fn: Callable[[StatsReport], None]) -> None:
+        self._listeners.append(fn)
+
+    def _notify(self, rep: StatsReport) -> None:
+        for fn in self._listeners:
+            fn(rep)
+
+    # -- query surface
+    def list_session_ids(self) -> List[str]:
+        raise NotImplementedError
+
+    def get_init_report(self, session_id: str) -> Optional[StatsInitializationReport]:
+        raise NotImplementedError
+
+    def get_updates(self, session_id: str) -> List[StatsReport]:
+        raise NotImplementedError
+
+    def get_latest_update(self, session_id: str) -> Optional[StatsReport]:
+        ups = self.get_updates(session_id)
+        return ups[-1] if ups else None
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """≙ ``storage/InMemoryStatsStorage.java``."""
+
+    def __init__(self):
+        super().__init__()
+        self._inits: Dict[str, StatsInitializationReport] = {}
+        self._updates: Dict[str, List[StatsReport]] = defaultdict(list)
+
+    def put_init_report(self, rep) -> None:
+        with self._lock:
+            self._inits[rep.session_id] = rep
+
+    def put_update(self, rep) -> None:
+        with self._lock:
+            self._updates[rep.session_id].append(rep)
+        self._notify(rep)
+
+    def list_session_ids(self) -> List[str]:
+        return sorted(set(self._inits) | set(self._updates))
+
+    def get_init_report(self, session_id):
+        return self._inits.get(session_id)
+
+    def get_updates(self, session_id) -> List[StatsReport]:
+        return list(self._updates.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only JSONL file store (replaces MapDB).
+    ≙ ``storage/mapdb/MapDBStatsStorage.java`` role."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._mem = InMemoryStatsStorage()
+        if os.path.exists(path):
+            self._load()
+
+    def _load(self) -> None:
+        with open(self.path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                d = json.loads(line)
+                kind = d.pop("type", "update")
+                if kind == "init":
+                    self._mem.put_init_report(StatsInitializationReport(**d))
+                else:
+                    self._mem.put_update(StatsReport(**d))
+
+    def _append(self, json_line: str) -> None:
+        with self._lock:
+            with open(self.path, "a", encoding="utf-8") as f:
+                f.write(json_line + "\n")
+
+    def put_init_report(self, rep) -> None:
+        self._mem.put_init_report(rep)
+        self._append(rep.to_json())
+
+    def put_update(self, rep) -> None:
+        self._mem.put_update(rep)
+        self._append(rep.to_json())
+        self._notify(rep)
+
+    def list_session_ids(self):
+        return self._mem.list_session_ids()
+
+    def get_init_report(self, session_id):
+        return self._mem.get_init_report(session_id)
+
+    def get_updates(self, session_id):
+        return self._mem.get_updates(session_id)

@@ -1,0 +1,187 @@
+"""Observability/UI tests (≙ BaseUiServerTest / TestRenders / stats storage
+suites)."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.ui import (
+    ChartHistogram,
+    ChartLine,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+    FileStatsStorage,
+    FlowIterationListener,
+    HistogramIterationListener,
+    InMemoryStatsStorage,
+    RemoteStatsListener,
+    StatsListener,
+    StatsReport,
+    StatsUpdateConfiguration,
+    UIServer,
+    component_from_dict,
+)
+
+
+def tiny_net():
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.5)
+            .list()
+            .layer(DenseLayer(n_in=2, n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_in=4, n_out=2, loss="mcxent", activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def xor():
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+    y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+    return x, y
+
+
+# --------------------------------------------------------------- listener
+
+def test_stats_listener_collects_scores_and_histograms():
+    net = tiny_net()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, session_id="s1"))
+    x, y = xor()
+    for _ in range(5):
+        net.fit(x, y)
+    assert storage.list_session_ids() == ["s1"]
+    init = storage.get_init_report("s1")
+    assert init.model_class == "MultiLayerNetwork"
+    assert init.num_params == net.num_params()
+    ups = storage.get_updates("s1")
+    assert len(ups) == 5
+    assert all(np.isfinite(u.score) for u in ups)
+    hist = ups[-1].param_histograms
+    assert any(k.endswith("/W") for k in hist)
+    k = next(iter(hist))
+    assert len(hist[k]["counts"]) == 20
+    assert sum(hist[k]["counts"]) > 0
+
+
+def test_stats_listener_frequency():
+    net = tiny_net()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(
+        storage, session_id="s2",
+        config=StatsUpdateConfiguration(reporting_frequency=3,
+                                        collect_histograms_params=False)))
+    x, y = xor()
+    for _ in range(9):
+        net.fit(x, y)
+    assert len(storage.get_updates("s2")) == 3   # iterations 3, 6, 9
+
+
+def test_flow_listener_records_structure():
+    net = tiny_net()
+    storage = InMemoryStatsStorage()
+    net.set_listeners(FlowIterationListener(storage, session_id="f1",
+                                            frequency=1))
+    x, y = xor()
+    net.fit(x, y)
+    flow = storage.get_updates("f1")[-1].param_stats["flow"]
+    assert len(flow["layers"]) == 2
+    assert flow["layers"][0]["params"] > 0
+
+
+# ---------------------------------------------------------------- storage
+
+def test_file_storage_roundtrip(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(p)
+    net = tiny_net()
+    net.set_listeners(HistogramIterationListener(storage))
+    x, y = xor()
+    for _ in range(3):
+        net.fit(x, y)
+    sid = storage.list_session_ids()[0]
+    reloaded = FileStatsStorage(p)
+    assert reloaded.list_session_ids() == storage.list_session_ids()
+    assert len(reloaded.get_updates(sid)) == 3
+    assert reloaded.get_init_report(sid) is not None
+
+
+def test_storage_listener_fanout():
+    storage = InMemoryStatsStorage()
+    got = []
+    storage.add_listener(lambda rep: got.append(rep.iteration))
+    storage.put_update(StatsReport(session_id="x", iteration=7,
+                                   timestamp=time.time()))
+    assert got == [7]
+
+
+# ----------------------------------------------------------------- server
+
+def test_ui_server_endpoints():
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage)
+    port = server.start()
+    try:
+        net = tiny_net()
+        net.set_listeners(StatsListener(storage, session_id="web"))
+        x, y = xor()
+        for _ in range(4):
+            net.fit(x, y)
+
+        def get(path):
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                        timeout=5) as r:
+                return r.read().decode()
+
+        assert "deeplearning4j_tpu training UI" in get("/train/")
+        assert json.loads(get("/train/sessions")) == ["web"]
+        ov = json.loads(get("/train/overview?sid=web"))
+        assert len(ov["iterations"]) == 4
+        assert len(ov["latest_histograms"]) > 0
+    finally:
+        server.stop()
+
+
+def test_remote_listener_posts_to_server():
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage)
+    port = server.start()
+    try:
+        net = tiny_net()
+        net.set_listeners(RemoteStatsListener(
+            f"http://127.0.0.1:{port}", session_id="remote1"))
+        x, y = xor()
+        for _ in range(3):
+            net.fit(x, y)
+        deadline = time.time() + 5
+        while time.time() < deadline and len(storage.get_updates("remote1")) < 3:
+            time.sleep(0.05)
+        assert len(storage.get_updates("remote1")) == 3
+    finally:
+        server.stop()
+
+
+def test_remote_listener_survives_dead_server():
+    net = tiny_net()
+    net.set_listeners(RemoteStatsListener("http://127.0.0.1:1",  # closed port
+                                          timeout=0.2))
+    x, y = xor()
+    net.fit(x, y)  # must not raise
+
+
+# ------------------------------------------------------------- components
+
+def test_chart_components_roundtrip():
+    line = ChartLine("score").add_series("s", [0, 1, 2], [3.0, 2.0, 1.0])
+    hist = ChartHistogram("w").add_bin(0, 1, 5).add_bin(1, 2, 3)
+    table = ComponentTable(["a", "b"]).add_row(1, 2)
+    div = ComponentDiv(line, hist, table, ComponentText("hello"))
+    d = json.loads(div.to_json())
+    back = component_from_dict(d)
+    assert back.to_dict() == div.to_dict()
+    assert d["components"][0]["series"][0]["y"] == [3.0, 2.0, 1.0]
