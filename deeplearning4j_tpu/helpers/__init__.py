@@ -1,0 +1,57 @@
+"""Accelerated-helper plugin layer (≙ deeplearning4j-cuda).
+
+Reference: the cuDNN helper SPI — ``deeplearning4j-nn/.../convolution/
+ConvolutionHelper.java:30-35`` (interface declared in core),
+``CudnnConvolutionHelper.java:51`` etc. (implementation in the acceleration
+module), discovered via ``Class.forName`` at layer construction
+(``ConvolutionLayer.java:58-65``) and transparently intercepting
+forward/backward.
+
+TPU translation: XLA already lowers conv/matmul/BN optimally onto the MXU,
+so the helper layer holds *Pallas* kernels only where a hand-fused VMEM pass
+beats stock XLA fusion (LRN's cross-channel window walk, fused BN-inference
+affine), plus the same discovery seam: layers ask ``get_helper(kind)`` and
+fall back to the pure-jnp path when helpers are disabled or unavailable —
+exactly how the reference degrades without cuDNN on the classpath.
+
+Toggle: ``enable_helpers(False)`` or env DL4J_TPU_DISABLE_HELPERS=1.
+Kernels run compiled on TPU and in interpret mode elsewhere, so the parity
+gradient-check suite (``tests/test_helpers.py``, ≙ CuDNNGradientChecks)
+exercises the same code path everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+_enabled = os.environ.get("DL4J_TPU_DISABLE_HELPERS", "0") != "1"
+_registry: Dict[str, object] = {}
+
+
+def enable_helpers(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def helpers_enabled() -> bool:
+    return _enabled
+
+
+def register_helper(kind: str, helper: object) -> None:
+    _registry[kind] = helper
+
+
+def get_helper(kind: str) -> Optional[object]:
+    """≙ the Class.forName discovery: None when disabled/absent, in which
+    case the layer uses its built-in path."""
+    if not _enabled:
+        return None
+    helper = _registry.get(kind)
+    if helper is None:
+        # lazy registration on first ask
+        from deeplearning4j_tpu.helpers import pallas_ops
+
+        pallas_ops.register_default_helpers()
+        helper = _registry.get(kind)
+    return helper

@@ -1,0 +1,213 @@
+"""Pallas TPU kernels behind the helper seam.
+
+≙ the cuDNN kernel implementations (``CudnnLocalResponseNormalizationHelper``,
+``CudnnBatchNormalizationHelper``) — re-derived as Pallas VMEM passes:
+
+- LRN forward + backward: the cross-channel window sum is materialised once
+  per block via lane-rolls inside VMEM (one HBM read/write per tensor),
+  where the stock XLA lowering builds an n-tap reduce_window; backward
+  reuses the same window structure via a custom VJP.
+- Fused BN inference: (x - mean) * rsqrt(var+eps) * gamma + beta in a single
+  elementwise pass with the per-channel affine computed in-kernel.
+
+Everything is rank-normalised to [rows, channels] blocks; wrappers pad rows
+to sublane (8) and channels to lane (128) multiples and slice back.  On
+non-TPU backends kernels run with ``interpret=True`` so CI and the parity
+gradient checks execute the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu import helpers as _helpers
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pad2(x, row_mult=8, lane_mult=128):
+    M, C = x.shape
+    Mp = (M + row_mult - 1) // row_mult * row_mult
+    Cp = (C + lane_mult - 1) // lane_mult * lane_mult
+    if Mp == M and Cp == C:
+        return x, M, C
+    return jnp.pad(x, ((0, Mp - M), (0, Cp - C))), M, C
+
+
+# ---------------------------------------------------------------------------
+# LRN: y = x * (k + alpha * window_sum(x^2))^(-beta)
+# ---------------------------------------------------------------------------
+
+def _window_sum(vals, half: int, C: int):
+    """Σ over channel offsets in [-half, half] with edge zeroing; lane rolls
+    stay in-register on the VPU."""
+    Cp = vals.shape[1]
+    acc = jnp.zeros_like(vals)
+    col = jax.lax.broadcasted_iota(jnp.int32, vals.shape, 1)
+    for w in range(-half, half + 1):
+        # circular roll by (-w mod Cp) puts vals[j+w] at lane j (roll shift
+        # must be non-negative); edge wrap-around is masked out below
+        rolled = pltpu.roll(vals, (-w) % Cp, 1) if w % Cp != 0 else vals
+        valid = (col + w >= 0) & (col + w < C)
+        acc = acc + jnp.where(valid, rolled, 0.0)
+    return acc
+
+
+def _lrn_fwd_kernel(x_ref, y_ref, s_ref, *, k, n, alpha, beta, C):
+    x = x_ref[:]
+    s = k + alpha * _window_sum(x * x, n // 2, C)
+    y_ref[:] = x * jnp.power(s, -beta)
+    s_ref[:] = s
+
+
+def _lrn_bwd_kernel(x_ref, s_ref, g_ref, dx_ref, *, n, alpha, beta, C):
+    x, s, g = x_ref[:], s_ref[:], g_ref[:]
+    # dx = g·s^{-β} − 2αβ·x·Σ_win(g·x·s^{-β-1})
+    t = g * x * jnp.power(s, -beta - 1.0)
+    dx_ref[:] = g * jnp.power(s, -beta) \
+        - 2.0 * alpha * beta * x * _window_sum(t, n // 2, C)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def lrn(x2d, k, n, alpha, beta):
+    return _lrn_fwd(x2d, k, n, alpha, beta)[0]
+
+
+def _lrn_fwd(x2d, k, n, alpha, beta):
+    xp, M, C = _pad2(x2d)
+    kern = functools.partial(_lrn_fwd_kernel, k=k, n=n, alpha=alpha,
+                             beta=beta, C=C)
+    y, s = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct(xp.shape, xp.dtype),
+                   jax.ShapeDtypeStruct(xp.shape, xp.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=_interpret(),
+    )(xp)
+    return y[:M, :C], (x2d, s[:M, :C])
+
+
+def _lrn_fwd_rule(x2d, k, n, alpha, beta):
+    y, res = _lrn_fwd(x2d, k, n, alpha, beta)
+    return y, res
+
+
+def _lrn_bwd_rule(k, n, alpha, beta, res, g):
+    x2d, s = res
+    xp, M, C = _pad2(x2d)
+    # pad lanes may compute inf/nan (0^-β etc.) — they are window-masked out
+    # of every valid lane and sliced off below, so zero padding is safe
+    sp, _, _ = _pad2(s)
+    gp, _, _ = _pad2(g)
+    kern = functools.partial(_lrn_bwd_kernel, n=n, alpha=alpha, beta=beta, C=C)
+    dx = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, xp.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(xp, sp, gp)
+    return (dx[:M, :C],)
+
+
+lrn.defvjp(_lrn_fwd_rule, _lrn_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
+# fused BN inference: y = (x - mean) * rsqrt(var + eps) * gamma + beta
+# ---------------------------------------------------------------------------
+
+def _bn_inf_kernel(x_ref, mean_ref, var_ref, gamma_ref, beta_ref, y_ref, *, eps):
+    scale = gamma_ref[:] * jax.lax.rsqrt(var_ref[:] + eps)
+    y_ref[:] = x_ref[:] * scale + (beta_ref[:] - mean_ref[:] * scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def bn_inference(x2d, mean, var, gamma, beta, eps):
+    """Single fused elementwise pass (helper fast path for serving).
+    Custom VJP: the affine backward is analytic, no need to differentiate
+    through the pallas_call."""
+    return _bn_inference_impl(x2d, mean, var, gamma, beta, eps)
+
+
+def _bn_inference_fwd(x2d, mean, var, gamma, beta, eps):
+    y = _bn_inference_impl(x2d, mean, var, gamma, beta, eps)
+    return y, (x2d, mean, var, gamma)
+
+
+def _bn_inference_bwd(eps, res, g):
+    x2d, mean, var, gamma = res
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x2d - mean) * inv
+    dx = g * (gamma * inv)
+    dgamma = (g * xhat).sum(0)
+    dbeta = g.sum(0)
+    dmean = -(g.sum(0)) * gamma * inv
+    dvar = (g * (x2d - mean)).sum(0) * gamma * (-0.5) * inv ** 3
+    return dx, dmean, dvar, dgamma, dbeta
+
+
+bn_inference.defvjp(_bn_inference_fwd, _bn_inference_bwd)
+
+
+def _bn_inference_impl(x2d, mean, var, gamma, beta, eps):
+    xp, M, C = _pad2(x2d)
+    Cp = xp.shape[1]
+
+    def pad_c(v, fill=0.0):
+        return jnp.pad(v.reshape(1, -1), ((0, 0), (0, Cp - C)),
+                       constant_values=fill)
+
+    kern = functools.partial(_bn_inf_kernel, eps=eps)
+    y = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct(xp.shape, xp.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(xp, pad_c(mean), pad_c(var, 1.0), pad_c(gamma), pad_c(beta))
+    return y[:M, :C]
+
+
+# ---------------------------------------------------------------------------
+# helper objects + registration
+# ---------------------------------------------------------------------------
+
+class PallasLRNHelper:
+    """≙ ``CudnnLocalResponseNormalizationHelper``."""
+
+    name = "PallasLRNHelper"
+
+    def apply(self, x, k, n, alpha, beta):
+        shape = x.shape
+        x2d = x.reshape(-1, shape[-1])
+        return lrn(x2d, float(k), int(n), float(alpha), float(beta)).reshape(shape)
+
+
+class PallasBatchNormHelper:
+    """≙ ``CudnnBatchNormalizationHelper`` (inference path)."""
+
+    name = "PallasBatchNormHelper"
+
+    def apply_inference(self, x, mean, var, gamma, beta, eps):
+        shape = x.shape
+        x2d = x.reshape(-1, shape[-1])
+        return bn_inference(x2d, mean, var, gamma, beta, float(eps)).reshape(shape)
+
+
+def register_default_helpers() -> None:
+    if "lrn" not in _helpers._registry:
+        _helpers.register_helper("lrn", PallasLRNHelper())
+    if "batch_norm" not in _helpers._registry:
+        _helpers.register_helper("batch_norm", PallasBatchNormHelper())

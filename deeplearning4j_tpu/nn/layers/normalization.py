@@ -71,6 +71,18 @@ class BatchNormalization(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
+            # helper fast path (≙ cuDNN BN helper hook, BatchNormalization
+            # .java:116-121): fused Pallas inference pass when available
+            from deeplearning4j_tpu import helpers as _h
+
+            helper = _h.get_helper("batch_norm")
+            if helper is not None:
+                gamma = (jnp.full((self.n_out,), self.gamma, x.dtype)
+                         if self.lock_gamma_beta else params["gamma"])
+                beta = (jnp.full((self.n_out,), self.beta, x.dtype)
+                        if self.lock_gamma_beta else params["beta"])
+                y = helper.apply_inference(x, mean, var, gamma, beta, self.eps)
+                return activations.get(self.activation)(y), new_state
         xhat = (x - mean) * lax.rsqrt(var + self.eps)
         if self.lock_gamma_beta:
             y = self.gamma * xhat + self.beta
@@ -101,6 +113,12 @@ class LocalResponseNormalization(Layer):
         return input_type
 
     def apply(self, params, state, x, *, train=False, rng=None):
+        # helper fast path (≙ CudnnLocalResponseNormalizationHelper hook)
+        from deeplearning4j_tpu import helpers as _h
+
+        helper = _h.get_helper("lrn")
+        if helper is not None:
+            return helper.apply(x, self.k, self.n, self.alpha, self.beta), state
         # NHWC: window-sum x^2 along the channel axis via reduce_window
         half = self.n // 2
         sq = x * x

@@ -1,0 +1,163 @@
+"""Helper (Pallas) parity tests — the CuDNNGradientChecks pattern.
+
+Reference: ``deeplearning4j-cuda/src/test/.../CuDNNGradientChecks.java:66,
+114-122`` — FIRST assert the accelerated helper is actually the one loaded
+(so the fast path is really exercised), THEN numerically gradient-check
+through it and compare against the plain path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import helpers
+from deeplearning4j_tpu.helpers import pallas_ops
+from deeplearning4j_tpu.nn.layers.normalization import (
+    BatchNormalization,
+    LocalResponseNormalization,
+)
+
+
+@pytest.fixture(autouse=True)
+def _helpers_on():
+    helpers.enable_helpers(True)
+    yield
+    helpers.enable_helpers(True)
+
+
+def test_helper_discovery_loads_pallas_impls():
+    """≙ CuDNNGradientChecks: assertTrue(helper instanceof Cudnn...)."""
+    h = helpers.get_helper("lrn")
+    assert h is not None and type(h).__name__ == "PallasLRNHelper"
+    h2 = helpers.get_helper("batch_norm")
+    assert h2 is not None and type(h2).__name__ == "PallasBatchNormHelper"
+
+
+def test_helper_disable_falls_back():
+    helpers.enable_helpers(False)
+    assert helpers.get_helper("lrn") is None
+
+
+def reference_lrn(x, k, n, alpha, beta):
+    """Plain-path LRN (the layer's reduce_window fallback), rank-4 NHWC."""
+    half = n // 2
+    ws = jax.lax.reduce_window(
+        x * x, 0.0, jax.lax.add,
+        window_dimensions=(1, 1, 1, n), window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (0, 0), (0, 0), (half, half)))
+    return x / jnp.power(k + alpha * ws, beta)
+
+
+def test_lrn_kernel_matches_reference_forward():
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 3, 5, 7).astype(np.float32))
+    h = helpers.get_helper("lrn")
+    got = h.apply(x, 2.0, 5, 1e-4, 0.75)
+    want = reference_lrn(x, 2.0, 5, 1e-4, 0.75)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_kernel_gradient_matches_reference():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(2, 3, 4, 6).astype(np.float32))
+    h = helpers.get_helper("lrn")
+
+    def f_helper(x):
+        return (h.apply(x, 2.0, 5, 1e-2, 0.75) ** 2).sum()
+
+    def f_plain(x):
+        return (reference_lrn(x, 2.0, 5, 1e-2, 0.75) ** 2).sum()
+
+    g_helper = jax.grad(f_helper)(x)
+    g_plain = jax.grad(f_plain)(x)
+    np.testing.assert_allclose(np.asarray(g_helper), np.asarray(g_plain),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_numerical_gradient_check():
+    """Central-difference check straight through the Pallas custom VJP
+    (the reference's GradientCheckUtil contract)."""
+    rs = np.random.RandomState(2)
+    x = rs.randn(3, 9).astype(np.float64)
+    k, n, alpha, beta = 2.0, 3, 0.1, 0.75
+
+    def f(v):
+        return float((pallas_ops.lrn(jnp.asarray(v), k, n, alpha, beta) ** 2).sum())
+
+    g = np.asarray(jax.grad(
+        lambda v: (pallas_ops.lrn(v, k, n, alpha, beta) ** 2).sum()
+    )(jnp.asarray(x)))
+    eps = 1e-5
+    for idx in [(0, 0), (1, 4), (2, 8), (0, 5)]:
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        num = (f(xp) - f(xm)) / (2 * eps)
+        assert abs(num - g[idx]) / max(abs(num), 1e-8) < 1e-3, \
+            f"grad mismatch at {idx}: {num} vs {g[idx]}"
+
+
+def test_bn_inference_fused_matches_plain():
+    rs = np.random.RandomState(3)
+    x = jnp.asarray(rs.randn(4, 5, 5, 8).astype(np.float32))
+    mean = jnp.asarray(rs.randn(8).astype(np.float32))
+    var = jnp.asarray(rs.rand(8).astype(np.float32) + 0.5)
+    gamma = jnp.asarray(rs.randn(8).astype(np.float32))
+    beta = jnp.asarray(rs.randn(8).astype(np.float32))
+    h = helpers.get_helper("batch_norm")
+    got = h.apply_inference(x, mean, var, gamma, beta, 1e-5)
+    want = gamma * (x - mean) * jax.lax.rsqrt(var + 1e-5) + beta
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_bn_layer_inference_uses_helper_and_matches_fallback():
+    """Same layer, helper on vs off → identical outputs (the
+    accelerated-vs-interpreted parity triangle leg)."""
+    rs = np.random.RandomState(4)
+    layer = BatchNormalization(n_out=6)
+    key = jax.random.PRNGKey(0)
+    params = layer.init(key)
+    state = {"mean": jnp.asarray(rs.randn(6).astype(np.float32)),
+             "var": jnp.asarray(rs.rand(6).astype(np.float32) + 0.5)}
+    x = jnp.asarray(rs.randn(10, 6).astype(np.float32))
+    helpers.enable_helpers(True)
+    y_fast, _ = layer.apply(params, state, x, train=False)
+    helpers.enable_helpers(False)
+    y_plain, _ = layer.apply(params, state, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_plain),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_layer_helper_vs_fallback_parity():
+    rs = np.random.RandomState(5)
+    layer = LocalResponseNormalization()
+    x = jnp.asarray(rs.randn(2, 4, 4, 5).astype(np.float32))
+    helpers.enable_helpers(True)
+    y_fast, _ = layer.apply({}, {}, x)
+    helpers.enable_helpers(False)
+    y_plain, _ = layer.apply({}, {}, x)
+    np.testing.assert_allclose(np.asarray(y_fast), np.asarray(y_plain),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lrn_under_jit_and_odd_shapes():
+    """Padding wrappers must survive jit and non-aligned channel counts."""
+    rs = np.random.RandomState(6)
+    h = helpers.get_helper("lrn")
+    for shape in [(1, 1, 1, 3), (2, 2, 2, 130), (5, 257)]:
+        x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+        if x.ndim == 2:
+            got = jax.jit(lambda v: pallas_ops.lrn(v, 2.0, 5, 1e-4, 0.75))(x)
+            ws = jax.lax.reduce_window(
+                x * x, 0.0, jax.lax.add, (1, 5), (1, 1),
+                ((0, 0), (2, 2)))
+            want = x / jnp.power(2.0 + 1e-4 * ws, 0.75)
+        else:
+            got = jax.jit(lambda v: h.apply(v, 2.0, 5, 1e-4, 0.75))(x)
+            want = reference_lrn(x, 2.0, 5, 1e-4, 0.75)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
