@@ -30,6 +30,10 @@ _registry: Dict[str, object] = {}
 
 
 def enable_helpers(on: bool = True) -> None:
+    """Toggle helper discovery.  NOTE: discovery happens at TRACE time, so
+    already-jitted programs (e.g. a model's cached train/output step) keep
+    whichever path they were traced with — toggle BEFORE first use, or use a
+    fresh model/jit cache when comparing helper vs built-in paths."""
     global _enabled
     _enabled = on
 

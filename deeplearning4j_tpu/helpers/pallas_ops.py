@@ -184,10 +184,26 @@ def _bn_inference_impl(x2d, mean, var, gamma, beta, eps):
 # helper objects + registration
 # ---------------------------------------------------------------------------
 
+# The kernels are single-block whole-array VMEM passes (no grid), so they
+# only apply below a VMEM budget: ~16 MiB/core shared by ~3 live f32 buffers.
+# Above it the layer's stock XLA path runs instead (which tiles fine).
+_VMEM_BUDGET_ELEMS = 1 << 20   # 4 MiB per f32 buffer
+
+
+def _fits_vmem(x) -> bool:
+    rows = int(np.prod(x.shape[:-1]))
+    cols = x.shape[-1]
+    padded = ((rows + 7) // 8 * 8) * ((cols + 127) // 128 * 128)
+    return padded <= _VMEM_BUDGET_ELEMS
+
+
 class PallasLRNHelper:
     """≙ ``CudnnLocalResponseNormalizationHelper``."""
 
     name = "PallasLRNHelper"
+
+    def supports(self, x) -> bool:
+        return _fits_vmem(x)
 
     def apply(self, x, k, n, alpha, beta):
         shape = x.shape
@@ -199,6 +215,9 @@ class PallasBatchNormHelper:
     """≙ ``CudnnBatchNormalizationHelper`` (inference path)."""
 
     name = "PallasBatchNormHelper"
+
+    def supports(self, x) -> bool:
+        return _fits_vmem(x)
 
     def apply_inference(self, x, mean, var, gamma, beta, eps):
         shape = x.shape

@@ -384,7 +384,7 @@ class ComputationGraph:
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
                 new_params = dict(params)
                 for lname, u in updates.items():
-                    new_params[lname] = {p: params[lname][p] - u[p] for p in u}
+                    new_params[lname] = upd.apply_updates(params[lname], u)
                 return new_params, new_us, new_ns, loss
 
             self._jit_cache["train_step"] = jax.jit(step, donate_argnums=(0, 1, 2))

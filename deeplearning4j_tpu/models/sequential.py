@@ -126,9 +126,12 @@ class MultiLayerNetwork:
                 h = layer.maybe_dropout(h, train=train, rng=rngs[i])
                 h = layer.pre_output(params[layer.name], h)
             else:
+                from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+                from deeplearning4j_tpu.nn.layers.composite import ResidualBlock
                 from deeplearning4j_tpu.nn.layers.convolution import GlobalPoolingLayer
 
-                kw = {"mask": fmask} if isinstance(layer, GlobalPoolingLayer) else {}
+                mask_aware = (GlobalPoolingLayer, SelfAttentionLayer, ResidualBlock)
+                kw = {"mask": fmask} if isinstance(layer, mask_aware) else {}
                 h, lst = layer.apply(params[layer.name], lstate, h,
                                      train=train, rng=rngs[i], **kw)
                 if lst:
@@ -174,9 +177,7 @@ class MultiLayerNetwork:
             )
             new_params = dict(params)
             for lname, u in updates.items():
-                new_params[lname] = {
-                    p: params[lname][p] - u[p] for p in u
-                }
+                new_params[lname] = upd.apply_updates(params[lname], u)
             return new_params, new_upd_state, new_net_state, loss, new_carries
 
         return jax.jit(step, donate_argnums=(0, 1, 2))

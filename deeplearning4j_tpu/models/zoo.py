@@ -149,3 +149,42 @@ def graves_lstm_char_lm(vocab_size: int = 77, hidden: int = 200,
                            activation="softmax"))
     conf = b.backprop_type("truncated_bptt", fwd_length=tbptt, back_length=tbptt).build()
     return MultiLayerNetwork(conf).init()
+
+
+def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
+                        n_heads: int = 4, layers: int = 2,
+                        ff_mult: int = 4, seed: int = 12345,
+                        updater: str = "adam", lr: float = 1e-3,
+                        seq_axis: Optional[str] = None) -> MultiLayerNetwork:
+    """Causal transformer char-LM — the long-context flagship (no reference
+    analog: the reference is pre-transformer, SURVEY.md §5).  With
+    ``seq_axis='seq'`` every attention layer runs ring attention over the
+    mesh sequence axis (see ``parallel.sequence_parallel``): train
+    sequences sharded over chips without materializing full K/V."""
+    from deeplearning4j_tpu.nn.layers import (
+        EmbeddingLayer, LayerNorm, ResidualBlock, SelfAttentionLayer,
+    )
+
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater, learning_rate=lr)
+        .list()
+    )
+    b.layer(EmbeddingLayer(n_in=vocab_size, n_out=d_model))
+    for i in range(layers):
+        b.layer(ResidualBlock(layers=(
+            LayerNorm(n_in=d_model),
+            SelfAttentionLayer(n_in=d_model, n_out=d_model,
+                               n_heads=n_heads, causal=True,
+                               seq_axis=seq_axis),
+        )))
+        b.layer(ResidualBlock(layers=(
+            LayerNorm(n_in=d_model),
+            DenseLayer(n_in=d_model, n_out=d_model * ff_mult, activation="relu"),
+            DenseLayer(n_in=d_model * ff_mult, n_out=d_model, activation="identity"),
+        )))
+    b.layer(LayerNorm(n_in=d_model))
+    b.layer(RnnOutputLayer(n_in=d_model, n_out=vocab_size, loss="mcxent",
+                           activation="softmax"))
+    return MultiLayerNetwork(b.build()).init()

@@ -13,8 +13,11 @@ from deeplearning4j_tpu.nn.layers.convolution import (
 )
 from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization,
+    LayerNorm,
     LocalResponseNormalization,
 )
+from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.layers.composite import ResidualBlock
 from deeplearning4j_tpu.nn.layers.recurrent import (
     GravesLSTM,
     GravesBidirectionalLSTM,

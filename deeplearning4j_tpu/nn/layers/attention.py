@@ -1,0 +1,137 @@
+"""Multi-head self-attention layers (TPU-first long-context extension).
+
+The reference is pre-transformer — its only long-sequence tools are
+truncated BPTT + masking (``nn/multilayer/MultiLayerNetwork.java:1176``,
+``:711``).  This framework makes long-context first-class: a fused-friendly
+local attention layer here, and ring / Ulysses sequence-parallel execution in
+:mod:`deeplearning4j_tpu.parallel.sequence_parallel` for sequences that do
+not fit one chip.
+
+Design notes (TPU):
+  - attention is computed head-batched as one ``jnp.einsum`` pair so XLA maps
+    it onto the MXU; no per-head Python loops.
+  - the layer is time-layout ``[B, T, F]`` like the rest of the recurrent
+    stack; masks broadcast ``[B, T]``.
+  - when ``seq_axis`` is set the layer computes ring attention over that
+    mesh axis (caller runs the step under ``shard_map`` — see
+    ``SequenceParallelTrainingMaster``); sequence shards never gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations, initializers
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+def split_heads(x: jax.Array, n_heads: int) -> jax.Array:
+    """[B, T, H*D] -> [B, T, H, D]"""
+    b, t, f = x.shape
+    return x.reshape(b, t, n_heads, f // n_heads)
+
+
+def merge_heads(x: jax.Array) -> jax.Array:
+    """[B, T, H, D] -> [B, T, H*D]"""
+    b, t, h, d = x.shape
+    return x.reshape(b, t, h * d)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    q_offset: int | jax.Array = 0,
+    k_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Scaled dot-product attention on ``[B, T, H, D]`` tensors.
+
+    ``q_offset``/``k_offset`` give the global time positions of the local
+    q/k blocks — this is what lets the same function serve as the per-block
+    kernel of ring attention (blockwise causal masking by global position).
+    Accumulates in float32 regardless of input dtype (MXU-friendly inputs,
+    stable softmax).
+    """
+    d = q.shape[-1]
+    acc = jnp.promote_types(q.dtype, jnp.float32)   # f32 accumulate, f64 for gradchecks
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(acc)
+    scores = scores / jnp.sqrt(jnp.asarray(d, acc))
+    neg = jnp.asarray(-1e30, acc)
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[1])
+        kpos = k_offset + jnp.arange(k.shape[1])
+        cm = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(cm[None, None, :, :], scores, neg)
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, neg)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over ``[B, T, F]``.
+
+    Params follow the framework's reference-style short names:
+    ``Wq/Wk/Wv/Wo`` + ``bq/bk/bv/bo``.  ``causal=True`` gives decoder
+    (language-model) masking.  ``seq_axis`` switches the inner product to
+    ring attention over that mesh axis (requires shard_map execution).
+    """
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    n_heads: int = 4
+    causal: bool = False
+    activation: str = "identity"
+    seq_axis: Optional[str] = None
+
+    def setup(self, input_type: InputType) -> "SelfAttentionLayer":
+        upd = {}
+        if self.n_in is None:
+            upd["n_in"] = input_type.size
+        if self.n_out is None:
+            upd["n_out"] = upd.get("n_in", self.n_in)
+        return dataclasses.replace(self, **upd) if upd else self
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def init(self, key, dtype=jnp.float32):
+        if self.n_out % self.n_heads:
+            raise ValueError(
+                f"n_out={self.n_out} not divisible by n_heads={self.n_heads}")
+        ks = jax.random.split(key, 4)
+        p: Dict[str, jax.Array] = {}
+        for name, k, (fi, fo) in (
+            ("Wq", ks[0], (self.n_in, self.n_out)),
+            ("Wk", ks[1], (self.n_in, self.n_out)),
+            ("Wv", ks[2], (self.n_in, self.n_out)),
+            ("Wo", ks[3], (self.n_out, self.n_out)),
+        ):
+            p[name] = initializers.init(self.weight_init, k, (fi, fo), dtype)
+            p["b" + name[1].lower()] = jnp.zeros((fo,), dtype)
+        return p
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        q = split_heads(x @ params["Wq"] + params["bq"], self.n_heads)
+        k = split_heads(x @ params["Wk"] + params["bk"], self.n_heads)
+        v = split_heads(x @ params["Wv"] + params["bv"], self.n_heads)
+        if self.seq_axis is not None:
+            from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
+
+            o = ring_attention(q, k, v, mask, axis_name=self.seq_axis,
+                               causal=self.causal)
+        else:
+            o = dot_product_attention(q, k, v, causal=self.causal, mask=mask)
+        y = merge_heads(o) @ params["Wo"] + params["bo"]
+        return activations.get(self.activation)(y), state

@@ -1,0 +1,89 @@
+"""Composite layers: ResidualBlock (sequential sublayers + skip connection).
+
+The reference expresses residual topology only through the ComputationGraph
+ElementWiseVertex DAG (``nn/graph/vertex/impl/ElementWiseVertex.java``); this
+composite gives the Sequential facade the same capability for uniform-width
+blocks (transformers, ResNet-style MLPs) — XLA fuses the add into the
+surrounding elementwise chain, so it costs nothing at runtime.
+
+Sublayers must be shape-preserving end-to-end and stateless (LayerNorm,
+SelfAttention, Dense are; BatchNorm is not — use the graph facade there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ResidualBlock(Layer):
+    """y = x + f(x) where f = sublayers applied in order."""
+
+    layers: Tuple[Layer, ...] = ()
+
+    def setup(self, input_type: InputType) -> "ResidualBlock":
+        done, it = [], input_type
+        for sub in self.layers:
+            sub = sub.setup(it)
+            it = sub.output_type(it)
+            done.append(sub)
+        return dataclasses.replace(self, layers=tuple(done))
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, dtype=jnp.float32):
+        ks = jax.random.split(key, max(len(self.layers), 1))
+        params: Dict[str, Any] = {}
+        for i, (sub, k) in enumerate(zip(self.layers, ks)):
+            if sub.has_params():
+                params[f"sub{i}"] = sub.init(k, dtype)
+        return params
+
+    def init_state(self):
+        for sub in self.layers:
+            if sub.init_state():
+                raise ValueError(
+                    "ResidualBlock sublayers must be stateless "
+                    f"(got state from {type(sub).__name__})")
+        return {}
+
+    def apply(self, params, state, x, *, train=False, rng=None, mask=None):
+        import inspect
+
+        h = x
+        rngs = (jax.random.split(rng, len(self.layers))
+                if rng is not None else [None] * len(self.layers))
+        for i, sub in enumerate(self.layers):
+            kw = ({"mask": mask} if mask is not None
+                  and "mask" in inspect.signature(sub.apply).parameters else {})
+            h, _ = sub.apply(params.get(f"sub{i}", {}), {}, h,
+                             train=train, rng=rngs[i], **kw)
+        return x + h, state
+
+    def reg_score(self, params):
+        total = jnp.zeros(())
+        for i, sub in enumerate(self.layers):
+            if sub.has_params():
+                total = total + sub.reg_score(params[f"sub{i}"])
+        return total
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "type": "ResidualBlock",
+            "name": self.name,
+            "layers": [sub.to_dict() for sub in self.layers],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ResidualBlock":
+        return cls(name=d.get("name"),
+                   layers=tuple(layer_from_dict(s) for s in d["layers"]))

@@ -76,7 +76,7 @@ class BatchNormalization(Layer):
             from deeplearning4j_tpu import helpers as _h
 
             helper = _h.get_helper("batch_norm")
-            if helper is not None:
+            if helper is not None and helper.supports(x):
                 gamma = (jnp.full((self.n_out,), self.gamma, x.dtype)
                          if self.lock_gamma_beta else params["gamma"])
                 beta = (jnp.full((self.n_out,), self.beta, x.dtype)
@@ -117,7 +117,7 @@ class LocalResponseNormalization(Layer):
         from deeplearning4j_tpu import helpers as _h
 
         helper = _h.get_helper("lrn")
-        if helper is not None:
+        if helper is not None and helper.supports(x):
             return helper.apply(x, self.k, self.n, self.alpha, self.beta), state
         # NHWC: window-sum x^2 along the channel axis via reduce_window
         half = self.n // 2
@@ -130,3 +130,38 @@ class LocalResponseNormalization(Layer):
         )
         denom = jnp.power(self.k + self.alpha * window_sum, self.beta)
         return x / denom, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LayerNorm(Layer):
+    """Per-example feature normalization (no reference analog — the
+    reference is pre-transformer; needed by the attention stack).
+    Normalizes over the trailing feature axis, so it is exactly
+    sequence-shard-safe: under sequence parallelism every timestep
+    normalizes locally with no collective."""
+
+    n_in: Optional[int] = None
+    eps: float = 1e-5
+    activation: str = "identity"
+
+    def setup(self, input_type: InputType) -> "LayerNorm":
+        if self.n_in is None:
+            return dataclasses.replace(self, n_in=input_type.size)
+        return self
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def init(self, key, dtype=jnp.float32):
+        return {
+            "gamma": jnp.ones((self.n_in,), dtype),
+            "beta": jnp.zeros((self.n_in,), dtype),
+        }
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * lax.rsqrt(var + self.eps)
+        y = params["gamma"] * y + params["beta"]
+        return activations.get(self.activation)(y), state

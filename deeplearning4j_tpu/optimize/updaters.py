@@ -134,63 +134,88 @@ def update(
 ):
     """Compute updates (to SUBTRACT from params) and new updater state.
 
-    ``grads``/``params`` pytrees are {layer_name: {param_name: arr}}; gradient
-    normalization is per-layer (the reference normalizes within each layer's
-    gradient view); lr_overrides maps layer_name -> lr.
+    ``grads``/``params`` pytrees are {layer_name: {param_name: arr}} — the
+    inner dict may nest further (composite layers, e.g. ResidualBlock), so
+    each layer's subtree is walked by tuple path; gradient normalization is
+    per-layer (the reference normalizes within each layer's gradient view);
+    lr_overrides maps layer_name -> lr.
     """
     lr_overrides = lr_overrides or {}
     name = cfg.name
     mu = current_momentum(cfg, iteration)
     it = jnp.asarray(iteration, jnp.float32)
 
+    def _flat(d, prefix=()):
+        out = {}
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out.update(_flat(v, prefix + (k,)))
+            else:
+                out[prefix + (k,)] = v
+        return out
+
+    def _unflat(flat):
+        out = {}
+        for path, v in flat.items():
+            cur = out
+            for k in path[:-1]:
+                cur = cur.setdefault(k, {})
+            cur[path[-1]] = v
+        return out
+
     new_state = {k: {} for k in state}
     updates = {}
     for lname, lgrads in grads.items():
+        lgrads = _flat(lgrads)
+        lstate_flat = {k: _flat(state[k].get(lname, {})) for k in state}
         lgrads = normalize_gradients(cfg, lgrads)
         lr = current_lr(cfg, it, lr_overrides.get(lname))
         lup = {}
+        lns = {k: {} for k in state}
         for pname, g in lgrads.items():
-            path = (lname, pname)
             if name in ("sgd",):
                 u = lr * g
             elif name in ("none", "noop"):
                 u = g
             elif name == "nesterovs":
-                v_prev = state["v"][lname][pname]
+                v_prev = lstate_flat["v"][pname]
                 v = mu * v_prev - lr * g
                 # reference Nesterov: update = -(mu * v - lr*g) applied as
                 # params += mu*v_new - lr*g  =>  subtract -(mu*v - lr*g)
                 u = -(mu * v - lr * g)
-                new_state.setdefault("v", {}).setdefault(lname, {})[pname] = v
+                lns["v"][pname] = v
             elif name == "adagrad":
-                h = state["h"][lname][pname] + g * g
+                h = lstate_flat["h"][pname] + g * g
                 u = lr * g / (jnp.sqrt(h) + cfg.epsilon)
-                new_state.setdefault("h", {}).setdefault(lname, {})[pname] = h
+                lns["h"][pname] = h
             elif name == "rmsprop":
-                ms = cfg.rmsprop_decay * state["ms"][lname][pname] + (1 - cfg.rmsprop_decay) * g * g
+                ms = cfg.rmsprop_decay * lstate_flat["ms"][pname] + (1 - cfg.rmsprop_decay) * g * g
                 u = lr * g / jnp.sqrt(ms + cfg.epsilon)
-                new_state.setdefault("ms", {}).setdefault(lname, {})[pname] = ms
+                lns["ms"][pname] = ms
             elif name == "adadelta":
-                msg = cfg.rho * state["msg"][lname][pname] + (1 - cfg.rho) * g * g
-                msdx_prev = state["msdx"][lname][pname]
+                msg = cfg.rho * lstate_flat["msg"][pname] + (1 - cfg.rho) * g * g
+                msdx_prev = lstate_flat["msdx"][pname]
                 dx = jnp.sqrt((msdx_prev + cfg.epsilon) / (msg + cfg.epsilon)) * g
                 msdx = cfg.rho * msdx_prev + (1 - cfg.rho) * dx * dx
                 u = dx  # adadelta has no lr
-                new_state.setdefault("msg", {}).setdefault(lname, {})[pname] = msg
-                new_state.setdefault("msdx", {}).setdefault(lname, {})[pname] = msdx
+                lns["msg"][pname] = msg
+                lns["msdx"][pname] = msdx
             elif name == "adam":
-                m = cfg.adam_beta1 * state["m"][lname][pname] + (1 - cfg.adam_beta1) * g
-                v = cfg.adam_beta2 * state["v"][lname][pname] + (1 - cfg.adam_beta2) * g * g
+                m = cfg.adam_beta1 * lstate_flat["m"][pname] + (1 - cfg.adam_beta1) * g
+                v = cfg.adam_beta2 * lstate_flat["v"][pname] + (1 - cfg.adam_beta2) * g * g
                 t = it + 1.0
                 mhat = m / (1 - jnp.power(cfg.adam_beta1, t))
                 vhat = v / (1 - jnp.power(cfg.adam_beta2, t))
                 u = lr * mhat / (jnp.sqrt(vhat) + cfg.epsilon)
-                new_state.setdefault("m", {}).setdefault(lname, {})[pname] = m
-                new_state.setdefault("v", {}).setdefault(lname, {})[pname] = v
+                lns["m"][pname] = m
+                lns["v"][pname] = v
             else:
                 raise ValueError(f"Unknown updater '{name}'")
             lup[pname] = u
-        updates[lname] = lup
+        updates[lname] = _unflat(lup)
+        for k, flat in lns.items():
+            if flat:
+                new_state[k][lname] = _unflat(flat)
     return updates, new_state
 
 

@@ -5,3 +5,9 @@ from deeplearning4j_tpu.parallel.training_master import (
     ParameterAveragingTrainingMaster,
     DistributedNetwork,
 )
+from deeplearning4j_tpu.parallel.sequence_parallel import (
+    SequenceParallelTrainingMaster,
+    ring_attention,
+    ring_self_attention,
+    ulysses_attention,
+)

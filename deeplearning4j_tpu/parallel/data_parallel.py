@@ -94,7 +94,7 @@ class ParallelWrapper:
             updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
             new_params = dict(params)
             for lname, u in updates.items():
-                new_params[lname] = {p: params[lname][p] - u[p] for p in u}
+                new_params[lname] = upd.apply_updates(params[lname], u)
             return new_params, new_us, new_ns, loss
 
         vstep = jax.vmap(one_replica_step, in_axes=(0, 0, 0, None, 0, 0, 0, 0, 0))

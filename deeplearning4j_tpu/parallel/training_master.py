@@ -91,7 +91,8 @@ class SyncTrainingMaster(TrainingMaster):
             grads = {k: v for k, v in grads.items() if v}
             updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
             new_params = {
-                ln: ({p: params[ln][p] - u[p] for p in u} if (u := updates.get(ln)) else params[ln])
+                ln: (upd.apply_updates(params[ln], u)
+                     if (u := updates.get(ln)) else params[ln])
                 for ln in params
             }
             return new_params, new_us, new_ns, loss

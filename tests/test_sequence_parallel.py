@@ -1,0 +1,133 @@
+"""Sequence/context parallelism tests.
+
+Correctness contract (reference test pattern, SURVEY.md §4): distributed
+attention == exact local attention, and sequence-parallel TRAINING ==
+single-device training, on the 8-virtual-device CPU mesh (conftest.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+from deeplearning4j_tpu.parallel import (
+    SequenceParallelTrainingMaster,
+    ring_self_attention,
+)
+
+
+def _qkv(rng, b=2, t=32, h=4, d=8):
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    return q, k, v
+
+
+def _seq_mesh(n_seq=4):
+    devs = np.array(jax.devices()[:n_seq]).reshape(1, 1, n_seq)
+    return Mesh(devs, (backend.AXIS_DATA, backend.AXIS_MODEL, backend.AXIS_SEQ))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_distributed_attention_matches_exact(causal, impl):
+    rng = np.random.default_rng(0)
+    q, k, v = _qkv(rng)
+    mesh = _seq_mesh(4)
+    expected = dot_product_attention(q, k, v, causal=causal)
+    got = ring_self_attention(q, k, v, mesh, causal=causal, impl=impl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_gradients_match_exact():
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, b=1, t=16, h=2, d=4)
+    mesh = _seq_mesh(4)
+
+    def loss_exact(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_self_attention(q, k, v, mesh, causal=True) ** 2)
+
+    ge = jax.grad(loss_exact, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(ge, gr):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_attention_layer_gradcheck():
+    """Numerical gradient check of the local attention layer — the
+    reference's central-difference oracle (GradientCheckUtil pattern)."""
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import (
+        LayerNorm, RnnOutputLayer, SelfAttentionLayer,
+    )
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .updater("sgd", learning_rate=0.1)
+        .list()
+        .layer(SelfAttentionLayer(n_in=6, n_out=6, n_heads=2, causal=True))
+        .layer(LayerNorm(n_in=6))
+        .layer(RnnOutputLayer(n_in=6, n_out=3, loss="mcxent", activation="softmax"))
+        .build()
+    )
+    net = MultiLayerNetwork(conf).init(dtype=jnp.float64)
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((2, 5, 6))
+    y = np.eye(3)[rng.integers(0, 3, (2, 5))]
+    assert check_gradients(net, x, y, epsilon=1e-6, max_rel_error=1e-3)
+
+
+def _char_batches(vocab, b, t, n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        x = rng.integers(0, vocab, (b, t)).astype(np.float32)
+        y = np.eye(vocab, dtype=np.float32)[rng.integers(0, vocab, (b, t))]
+        out.append(DataSet(x, y))
+    return out
+
+
+def test_sequence_parallel_training_matches_single_device():
+    """Transformer LM trained with (data=2, seq=4) sharding == the same
+    model trained on one device — the TestCompareParameterAveraging...
+    equivalence, extended to SP."""
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    vocab, b, t = 11, 4, 16
+    batches = _char_batches(vocab, b, t, n=3)
+
+    # single-device reference
+    # plain SGD: linear in the gradient, so fp-reordering noise stays tiny
+    # (adam's 1/sqrt(v) amplifies near-zero-grad sign flips; the reference
+    # equivalence tests also compare under plain SGD)
+    ref = transformer_char_lm(vocab_size=vocab, d_model=16, n_heads=2,
+                              layers=1, seed=7, updater="sgd", lr=0.1)
+    for ds in batches:
+        ref.fit(ds.features, ds.labels)
+
+    # sequence-parallel: same seed -> identical init
+    devs = np.array(jax.devices()[:8]).reshape(2, 1, 4)
+    mesh = Mesh(devs, (backend.AXIS_DATA, backend.AXIS_MODEL, backend.AXIS_SEQ))
+    sp_net = transformer_char_lm(vocab_size=vocab, d_model=16, n_heads=2,
+                                 layers=1, seed=7, updater="sgd", lr=0.1,
+                                 seq_axis=backend.AXIS_SEQ)
+    master = SequenceParallelTrainingMaster(mesh=mesh)
+    master.execute_training(sp_net, batches)
+
+    ref_vec = ref.params_to_vector()
+    sp_vec = sp_net.params_to_vector()
+    np.testing.assert_allclose(sp_vec, ref_vec, rtol=1e-4, atol=1e-5)
+    assert abs(sp_net.score_value - ref.score_value) < 1e-4
