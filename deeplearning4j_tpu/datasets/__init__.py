@@ -6,6 +6,12 @@ from deeplearning4j_tpu.datasets.iterator import (
     MultipleEpochsIterator,
     SamplingDataSetIterator,
     IteratorDataSetIterator,
+    NativeBatchDataSetIterator,
 )
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator
+from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator
+from deeplearning4j_tpu.datasets.curves import CurvesDataSetIterator
+from deeplearning4j_tpu.datasets.lfw import LFWDataSetIterator
+from deeplearning4j_tpu.datasets.export import export_datasets, FileDataSetIterator
+from deeplearning4j_tpu.datasets import datavec

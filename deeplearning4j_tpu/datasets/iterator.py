@@ -200,6 +200,71 @@ class SamplingDataSetIterator(DataSetIterator):
         return self._batch_size
 
 
+class NativeBatchDataSetIterator(DataSetIterator):
+    """Shuffled minibatch iterator over an in-memory DataSet, backed by the
+    native C++ async pipeline (producer thread + reusable buffer pool —
+    deeplearning4j_tpu/native).  The TPU-era AsyncDataSetIterator: batch
+    assembly happens off the Python thread entirely; short final batches
+    arrive zero-padded with a synthesized labels mask (static shapes)."""
+
+    def __init__(self, data: DataSet, batch_size: int, shuffle: bool = True,
+                 seed: int = 1, drop_last: bool = False):
+        from deeplearning4j_tpu import native
+
+        if data.features_mask is not None or data.labels_mask is not None:
+            raise ValueError("masked DataSets are not supported; use "
+                             "ListDataSetIterator")
+        self._data = data
+        self._batch_size = batch_size
+        self._seed = seed
+        self._resets = 0
+        self._batcher = native.Batcher(data.features, data.labels, batch_size,
+                                       shuffle=shuffle, seed=seed,
+                                       drop_last=drop_last)
+        self._pending: Optional[DataSet] = None
+        self._advance()
+
+    def _advance(self):
+        out = self._batcher.next()
+        if out is None:
+            self._pending = None
+            return
+        feat, lab, n_valid = out
+        ds = DataSet(feat, lab)
+        if n_valid < self._batch_size:
+            ds = DataSet(feat[:n_valid], lab[:n_valid]).pad_batch(
+                self._batch_size)
+        self._pending = ds
+
+    def has_next(self):
+        return self._pending is not None
+
+    def next(self):
+        out = self._pending
+        if out is None:
+            raise StopIteration
+        self._advance()
+        return out
+
+    def reset(self):
+        # new permutation each epoch (deterministic given the base seed)
+        self._resets += 1
+        self._batcher.reset(self._seed + self._resets)
+        self._advance()
+
+    def batch(self):
+        return self._batch_size
+
+    def total_examples(self):
+        return len(self._data)
+
+    def async_supported(self):
+        return False  # already asynchronous
+
+    def close(self):
+        self._batcher.close()
+
+
 _SENTINEL = object()
 
 

@@ -1,0 +1,90 @@
+"""LFW (Labeled Faces in the Wild) fetcher + iterator.
+
+Reference: ``deeplearning4j-core/.../datasets/fetchers/LFWDataFetcher.java``
++ ``iterator/impl/LFWDataSetIterator.java`` (downloads the LFW archive, one
+directory per person, images resized to a fixed shape, person index as the
+class label).  No egress here, so:
+ 1. load ``faces.npy``/``labels.npy`` (or per-class ``<name>.npy`` stacks)
+    from ``DL4J_TPU_LFW_DIR`` when present;
+ 2. otherwise generate deterministic synthetic face-shaped images
+    (elliptical head + class-dependent feature geometry), flagged
+    ``is_synthetic``.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+
+SIDE = 40
+
+
+def _synthetic_faces(n: int, num_classes: int, seed: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, n)
+    yy, xx = np.meshgrid(np.arange(SIDE), np.arange(SIDE), indexing="ij")
+    imgs = np.zeros((n, SIDE, SIDE), np.float32)
+    for i, c in enumerate(labels):
+        cy, cx = SIDE / 2 + rng.randn(), SIDE / 2 + rng.randn()
+        ry = SIDE * (0.32 + 0.015 * (c % 5))
+        rx = SIDE * (0.25 + 0.012 * (c % 7))
+        head = (((yy - cy) / ry) ** 2 + ((xx - cx) / rx) ** 2) < 1.0
+        img = head.astype(np.float32) * 0.6
+        eye_dy, eye_dx = SIDE * 0.12, SIDE * (0.10 + 0.01 * (c % 3))
+        for sx in (-1, 1):
+            ey, ex = int(cy - eye_dy), int(cx + sx * eye_dx)
+            img[ey - 1:ey + 2, ex - 1:ex + 2] = 1.0
+        mw = int(SIDE * (0.06 + 0.01 * (c % 4)))
+        my = int(cy + SIDE * 0.15)
+        img[my, int(cx) - mw:int(cx) + mw + 1] = 1.0
+        img += rng.rand(SIDE, SIDE).astype(np.float32) * 0.1
+        imgs[i] = np.clip(img, 0, 1)
+    return imgs.reshape(n, SIDE * SIDE), labels
+
+
+class LFWDataFetcher:
+    def __init__(self, num_examples: Optional[int] = None,
+                 num_classes: int = 10, data_dir: Optional[str] = None,
+                 seed: int = 123, allow_synthetic: bool = True):
+        root = Path(data_dir or os.environ.get(
+            "DL4J_TPU_LFW_DIR", Path.home() / ".deeplearning4j_tpu" / "lfw"))
+        feats = labels = None
+        if (root / "faces.npy").exists() and (root / "labels.npy").exists():
+            feats = np.load(root / "faces.npy").astype(np.float32)
+            labels = np.load(root / "labels.npy").astype(np.int64)
+            feats = feats.reshape(len(feats), -1)
+            num_classes = int(labels.max()) + 1
+        self.is_synthetic = feats is None
+        if feats is None:
+            if not allow_synthetic:
+                raise FileNotFoundError(
+                    f"LFW arrays not found under {root}; set DL4J_TPU_LFW_DIR")
+            n = num_examples or 1024
+            feats, labels = _synthetic_faces(n, num_classes, seed)
+        if num_examples is not None:
+            feats, labels = feats[:num_examples], labels[:num_examples]
+        self.num_classes = num_classes
+        self.features = feats
+        self.labels = np.eye(num_classes, dtype=np.float32)[labels]
+
+    def dataset(self) -> DataSet:
+        return DataSet(self.features, self.labels)
+
+
+class LFWDataSetIterator(ListDataSetIterator):
+    def __init__(self, batch_size: int, num_examples: Optional[int] = None,
+                 num_classes: int = 10, seed: int = 123,
+                 data_dir: Optional[str] = None, drop_last: bool = False):
+        fetcher = LFWDataFetcher(num_examples=num_examples,
+                                 num_classes=num_classes, data_dir=data_dir,
+                                 seed=seed)
+        self.is_synthetic = fetcher.is_synthetic
+        self.num_classes = fetcher.num_classes
+        super().__init__(fetcher.dataset(), batch_size, drop_last=drop_last)

@@ -203,6 +203,9 @@ class GraphBuilder:
                 ),
             )
         conf.validate()
+        for n in conf.nodes:
+            if n.layer is not None:
+                n.layer.validate()
         return conf
 
 

@@ -178,6 +178,7 @@ class ListBuilder:
         cur_type = self._input_type
         for i, layer in enumerate(self._layers):
             layer = p._apply_global_defaults(layer)
+            layer.validate()
             if layer.name is None:
                 layer = layer.with_name(f"layer_{i}")
             if cur_type is not None:

@@ -29,6 +29,18 @@ def _fans(shape: Sequence[int], fan_in: Optional[int], fan_out: Optional[int]) -
     return shape[-2] * receptive, shape[-1] * receptive
 
 
+KNOWN = frozenset({
+    "zero", "ones", "uniform", "xavier", "xavier_uniform", "xavier_fan_in",
+    "xavier_legacy", "relu", "relu_uniform", "sigmoid_uniform", "normal",
+    "distribution",
+})
+
+
+def check(name: str) -> None:
+    if name.lower() not in KNOWN:
+        raise ValueError(f"Unknown weight init '{name}'. Known: {sorted(KNOWN)}")
+
+
 def init(
     name: str,
     key: jax.Array,

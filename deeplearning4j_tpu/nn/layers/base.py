@@ -66,6 +66,15 @@ class Layer:
     learning_rate: Optional[float] = None   # per-layer lr override
     bias_init: float = 0.0
 
+    # ---- validation -----------------------------------------------------
+    def validate(self) -> None:
+        """Fail fast at build time on unknown activation / weight-init names
+        (otherwise the error would surface mid-trace at first fit/output)."""
+        from deeplearning4j_tpu.nn import activations, initializers
+
+        activations.get(self.activation)
+        initializers.check(self.weight_init)
+
     # ---- shape plumbing -------------------------------------------------
     def setup(self, input_type: InputType) -> "Layer":
         """Return a completed copy with sizes inferred from input_type."""

@@ -64,6 +64,10 @@ class OutputLayer(DenseLayer):
 
     loss: str = "mcxent"
 
+    def validate(self) -> None:
+        super().validate()
+        losses.get(self.loss)
+
     def score(self, params, x, labels, mask=None):
         pre = self.pre_output(params, x)
         return losses.score(self.loss, labels, pre, self.activation, mask)

@@ -1,0 +1,231 @@
+"""Native C++ runtime core + DataVec bridge + dataset fetchers.
+
+Mirrors the reference's test pattern for its native seam: the same
+operation run through the accelerated path and the plain path must agree
+exactly (CuDNNGradientChecks-style parity, here for host-side ETL)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.datasets import (
+    CifarDataSetIterator, CurvesDataSetIterator, DataSet, FileDataSetIterator,
+    LFWDataSetIterator, ListDataSetIterator, NativeBatchDataSetIterator,
+    export_datasets,
+)
+from deeplearning4j_tpu.datasets.datavec import (
+    ALIGN_END, CollectionRecordReader, CollectionSequenceRecordReader,
+    CSVRecordReader, CSVSequenceRecordReader, RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
+
+
+def test_native_available():
+    # g++ is part of the supported toolchain; the native path must build here
+    assert native.available()
+
+
+def test_csv_native_python_parity():
+    data = b"a,b,c\n1.5,2,3\n-4,5e-1,6\n7,8,9.25\n"
+    m = native.csv_to_matrix(data, skip_lines=1)
+    mp = native.csv_to_matrix(data, skip_lines=1, force_python=True)
+    np.testing.assert_allclose(m, mp)
+    assert m.shape == (3, 3) and m[1, 1] == pytest.approx(0.5)
+
+
+def test_csv_nonnumeric_falls_back():
+    data = b"1,2\n3,4\n"
+    ok = native.csv_to_matrix(data)
+    np.testing.assert_allclose(ok, [[1, 2], [3, 4]])
+    with pytest.raises(ValueError):
+        native.csv_to_matrix(b"1,x\n")
+
+
+def test_idx_parsers_parity():
+    imgs = struct.pack(">IIII", 0x803, 4, 3, 3) + bytes(range(36))
+    labs = struct.pack(">II", 0x801, 4) + bytes([1, 0, 9, 5])
+    np.testing.assert_allclose(native.parse_idx_images(imgs),
+                               native.parse_idx_images(imgs, force_python=True))
+    np.testing.assert_allclose(native.parse_idx_labels(labs),
+                               native.parse_idx_labels(labs, force_python=True))
+    assert native.parse_idx_labels(labs)[2, 9] == 1.0
+
+
+def test_gather_rows():
+    src = np.arange(50, dtype=np.float32).reshape(10, 5)
+    idx = np.array([9, 0, 3, 3])
+    np.testing.assert_allclose(native.gather_rows(src, idx), src[idx])
+    with pytest.raises(IndexError):
+        native.gather_rows(src, np.array([10]))
+
+
+def test_csv_ragged_rows_rejected():
+    # extra trailing field must not be silently dropped by the native path
+    with pytest.raises(ValueError):
+        native.csv_to_matrix(b"1,2\n3,4,5\n")
+
+
+def test_native_batch_iterator_reshuffles_per_epoch():
+    f = np.arange(32, dtype=np.float32).reshape(32, 1)
+    l = np.zeros((32, 1), np.float32)
+    it = NativeBatchDataSetIterator(DataSet(f, l), 32, seed=4)
+    first = it.next().features[:, 0].copy()
+    it.reset()
+    second = it.next().features[:, 0].copy()
+    assert sorted(first) == sorted(second)
+    assert not np.array_equal(first, second)
+    it.close()
+
+
+def test_export_mask_roundtrip(tmp_path):
+    # 5 examples batched by 4 -> final batch zero-padded with a labels mask
+    rs = np.random.RandomState(3)
+    ds = DataSet(rs.rand(5, 4).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[rs.randint(0, 2, 5)])
+    export_datasets(ListDataSetIterator(ds, 4), tmp_path)
+    batches = list(FileDataSetIterator(tmp_path))
+    assert batches[1].labels_mask is not None
+    np.testing.assert_allclose(batches[1].labels_mask, [1, 0, 0, 0])
+
+
+def test_batcher_covers_every_row_once():
+    f = np.arange(37, dtype=np.float32).reshape(37, 1)
+    b = native.Batcher(f, None, 8, shuffle=True, seed=5)
+    seen = []
+    while True:
+        out = b.next()
+        if out is None:
+            break
+        feat, lab, nv = out
+        assert lab is None
+        seen.extend(feat[:nv, 0].tolist())
+    b.close()
+    assert sorted(seen) == list(range(37))
+
+
+def test_batcher_native_python_identical_order():
+    f = np.random.RandomState(0).rand(41, 3).astype(np.float32)
+    l = np.eye(4, dtype=np.float32)[np.random.RandomState(1).randint(0, 4, 41)]
+    bn = native.Batcher(f, l, 8, seed=9)
+    bp = native.Batcher(f, l, 8, seed=9, force_python=True)
+    while True:
+        a, b = bn.next(), bp.next()
+        if a is None:
+            assert b is None
+            break
+        np.testing.assert_allclose(a[0], b[0])
+        np.testing.assert_allclose(a[1], b[1])
+        assert a[2] == b[2]
+    bn.close(), bp.close()
+
+
+def test_native_batch_iterator_trains():
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(64, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 64)]
+    it = NativeBatchDataSetIterator(DataSet(x, y), 16, seed=3)
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("sgd", learning_rate=0.1).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(it, epochs=2)
+    assert np.isfinite(net.score_value)
+    it.close()
+
+
+def test_dataset_export_roundtrip(tmp_path):
+    rs = np.random.RandomState(2)
+    ds = DataSet(rs.rand(20, 6).astype(np.float32),
+                 rs.rand(20, 2).astype(np.float32))
+    src = ListDataSetIterator(ds, 8)
+    paths = export_datasets(src, tmp_path)
+    assert len(paths) == 3
+    back = FileDataSetIterator(tmp_path)
+    merged = DataSet.merge(list(back))
+    # final batch was zero-padded to 8 on export
+    np.testing.assert_allclose(merged.features[:20], ds.features, atol=1e-6)
+    assert back.batch() == 8
+
+
+def test_csv_record_reader_iterator(tmp_path):
+    p = tmp_path / "data.csv"
+    p.write_text("f1,f2,label\n0.1,0.2,0\n0.3,0.4,2\n0.5,0.6,1\n")
+    reader = CSVRecordReader(skip_lines=1).initialize(p)
+    it = RecordReaderDataSetIterator(reader, batch_size=2, label_index=2,
+                                     num_classes=3)
+    b1 = it.next()
+    assert b1.features.shape == (2, 2) and b1.labels.shape == (2, 3)
+    assert b1.labels[1, 2] == 1.0
+    b2 = it.next()
+    assert len(b2) == 1 and not it.has_next()
+    it.reset()
+    assert it.has_next()
+
+
+def test_record_reader_regression():
+    reader = CollectionRecordReader([[1, 2, 10, 20], [3, 4, 30, 40]])
+    it = RecordReaderDataSetIterator(reader, 2, label_index=2, regression=True,
+                                     label_index_to=3)
+    ds = it.next()
+    np.testing.assert_allclose(ds.features, [[1, 2], [3, 4]])
+    np.testing.assert_allclose(ds.labels, [[10, 20], [30, 40]])
+
+
+def test_sequence_reader_masks_and_alignment(tmp_path):
+    feats = CollectionSequenceRecordReader(
+        [[[1, 2], [3, 4], [5, 6]], [[7, 8]]])
+    labels = CollectionSequenceRecordReader([[[0], [1], [0]], [[1]]])
+    it = SequenceRecordReaderDataSetIterator(
+        feats, labels, batch_size=2, num_classes=2, alignment=ALIGN_END)
+    ds = it.next()
+    assert ds.features.shape == (2, 3, 2) and ds.labels.shape == (2, 3, 2)
+    # second sequence (length 1) is aligned to the END of the time axis
+    np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [0, 0, 1]])
+    np.testing.assert_allclose(ds.features[1, 2], [7, 8])
+    assert ds.labels[1, 2, 1] == 1.0
+
+
+def test_csv_sequence_reader(tmp_path):
+    for i, rows in enumerate(["1,2\n3,4\n", "5,6\n"]):
+        (tmp_path / f"seq_{i}.csv").write_text(rows)
+    reader = CSVSequenceRecordReader().initialize(
+        sorted(tmp_path.glob("seq_*.csv")))
+    it = SequenceRecordReaderDataSetIterator(reader, batch_size=2,
+                                             label_index=1, num_classes=7)
+    ds = it.next()
+    assert ds.features.shape == (2, 2, 1)
+    assert ds.labels[0, 1, 4] == 1.0  # label value 4 one-hot
+
+
+def test_cifar_curves_lfw_iterators():
+    c = CifarDataSetIterator(batch_size=16, num_examples=32)
+    ds = c.next()
+    assert ds.features.shape == (16, 3072) and ds.labels.shape == (16, 10)
+    assert c.is_synthetic
+    cv = CurvesDataSetIterator(batch_size=8, num_examples=16)
+    d2 = cv.next()
+    np.testing.assert_allclose(d2.features, d2.labels)
+    lfw = LFWDataSetIterator(batch_size=8, num_examples=16, num_classes=5)
+    d3 = lfw.next()
+    assert d3.features.shape == (8, 1600) and d3.labels.shape == (8, 5)
+
+
+def test_cifar_real_binary_format(tmp_path):
+    # write two records in the authentic data_batch format and parse them
+    rec = bytes([3]) + bytes(range(256)) * 12  # label 3 + 3072 bytes
+    rec2 = bytes([7]) + bytes([255] * 3072)
+    (tmp_path / "data_batch_1.bin").write_bytes(rec + rec2)
+    it = CifarDataSetIterator(batch_size=2, data_dir=str(tmp_path))
+    assert not it.is_synthetic
+    ds = it.next()
+    assert ds.labels[0, 3] == 1.0 and ds.labels[1, 7] == 1.0
+    assert ds.features[1].max() == pytest.approx(1.0)
