@@ -63,9 +63,10 @@ class CollectionRecordReader(RecordReader):
 
 
 class CSVRecordReader(RecordReader):
-    """CSV file/string reader (reference CSVRecordReader): one record per
-    line, optional header skip.  All-numeric files parse through the native
-    multithreaded path."""
+    """CSV reader (reference CSVRecordReader): one record per line, optional
+    header skip.  All-numeric files parse through the native multithreaded
+    path.  ``initialize`` takes a file path (str/Path) or literal CSV
+    content as ``bytes``."""
 
     def __init__(self, skip_lines: int = 0, delimiter: str = ","):
         self.skip_lines = skip_lines
@@ -74,12 +75,15 @@ class CSVRecordReader(RecordReader):
         self._pos = 0
 
     def initialize(self, source: Union[str, Path, bytes]) -> "CSVRecordReader":
-        if isinstance(source, (str, Path)) and Path(source).exists():
-            data = Path(source).read_bytes()
-        elif isinstance(source, bytes):
+        if isinstance(source, bytes):
             data = source
         else:
-            data = str(source).encode()
+            path = Path(source)
+            if not path.exists():
+                raise FileNotFoundError(
+                    f"CSV file not found: {path} (pass literal content as "
+                    "bytes)")
+            data = path.read_bytes()
         self._matrix = native.csv_to_matrix(data, self.delimiter,
                                             self.skip_lines)
         self._pos = 0
@@ -161,8 +165,11 @@ class CSVSequenceRecordReader(SequenceRecordReader):
 
 
 def _one_hot(value: float, num_classes: int) -> np.ndarray:
+    c = int(value)
+    if not 0 <= c < num_classes:
+        raise ValueError(f"label value {value} outside [0, {num_classes})")
     out = np.zeros(num_classes, np.float32)
-    out[int(value)] = 1.0
+    out[c] = 1.0
     return out
 
 

@@ -34,7 +34,7 @@ def export_datasets(iterator: DataSetIterator, out_dir: Union[str, Path],
     i = 0
     while iterator.has_next():
         ds = iterator.next()
-        p = out / f"{prefix}_{i}.bin"
+        p = out / f"{prefix}_{i:05d}.bin"  # zero-padded so glob-sort == order
         native.write_dataset(p, ds.features, ds.labels)
         if ds.features_mask is not None or ds.labels_mask is not None:
             masks = {}

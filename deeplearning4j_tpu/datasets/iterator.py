@@ -230,11 +230,14 @@ class NativeBatchDataSetIterator(DataSetIterator):
             self._pending = None
             return
         feat, lab, n_valid = out
-        ds = DataSet(feat, lab)
+        lmask = None
         if n_valid < self._batch_size:
-            ds = DataSet(feat[:n_valid], lab[:n_valid]).pad_batch(
-                self._batch_size)
-        self._pending = ds
+            # batch already zero-padded by the batcher; just mark valid rows
+            shape = ((self._batch_size,) if lab.ndim == 2
+                     else (self._batch_size, lab.shape[1]))
+            lmask = np.zeros(shape, np.float32)
+            lmask[:n_valid] = 1.0
+        self._pending = DataSet(feat, lab, None, lmask)
 
     def has_next(self):
         return self._pending is not None

@@ -83,7 +83,10 @@ def parse_idx_labels(data: bytes, n_classes: int = 10,
         if got == n:
             return out
     raw = np.frombuffer(data, np.uint8, count=n, offset=8)
-    return np.eye(n_classes, dtype=np.float32)[raw]
+    out = np.zeros((n, n_classes), np.float32)
+    valid = raw < n_classes  # out-of-range labels -> all-zero row (native parity)
+    out[np.nonzero(valid)[0], raw[valid]] = 1.0
+    return out
 
 
 def gather_rows(src: np.ndarray, idx: np.ndarray,
@@ -129,11 +132,14 @@ class Batcher:
         self._handle = None
         self._L = None if force_python else lib()
         if self._L is not None:
+            # gather single-threaded: the producer thread is already off the
+            # consumer's critical path, and per-batch thread spawn would cost
+            # more than the copy for typical minibatch sizes
             self._handle = self._L.batcher_create(
                 _fp(self._f), None if self._l is None else _fp(self._l),
                 self._n, self._f.shape[1],
                 0 if self._l is None else self._l.shape[1],
-                batch_size, int(shuffle), seed, 0, queue_cap, int(drop_last))
+                batch_size, int(shuffle), seed, 1, queue_cap, int(drop_last))
         else:
             self._py_reset(seed)
 

@@ -104,14 +104,17 @@ def lib() -> Optional[ctypes.CDLL]:
                 return None
         try:
             candidate = _bind(ctypes.CDLL(str(_SO)))
-        except OSError:
-            return None
-        if candidate.dl4j_native_abi_version() != ABI_VERSION:
-            # stale binary from an older source; rebuild once
+            stale = candidate.dl4j_native_abi_version() != ABI_VERSION
+        except (OSError, AttributeError):
+            stale = True  # unloadable or missing symbols: rebuild once
+        if stale:
             _SO.unlink(missing_ok=True)
             if not _build():
                 return None
-            candidate = _bind(ctypes.CDLL(str(_SO)))
+            try:
+                candidate = _bind(ctypes.CDLL(str(_SO)))
+            except (OSError, AttributeError):
+                return None
         _lib = candidate
         return _lib
 

@@ -1,0 +1,3 @@
+from deeplearning4j_tpu.provision.cluster import (
+    ClusterSpec, ClusterSetup, bootstrap_distributed, HostProvisioner,
+)

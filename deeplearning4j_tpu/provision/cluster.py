@@ -1,0 +1,168 @@
+"""Cluster provisioning — the deeplearning4j-aws analog for TPU pods.
+
+Reference: ``deeplearning4j-aws/.../ec2/Ec2BoxCreator.java`` (create boxes),
+``ec2/provision/HostProvisioner.java`` (ssh: upload artifact, run remote
+commands), ``ec2/provision/ClusterSetup.java`` (wire the hosts into a
+training cluster and launch the distributed trainer).
+
+TPU redesign: "boxes" are TPU pod-slice workers.  Provisioning emits the
+exact gcloud/ssh command lines and per-worker bootstrap scripts (this
+environment has no cloud egress, so commands are generated, not executed —
+the operator or a CI layer runs them).  The runtime half,
+``bootstrap_distributed``, is what each worker executes at startup: it reads
+the standard TPU pod env (or explicit args) and brings up
+``jax.distributed`` so the whole pod becomes one mesh — XLA then routes
+collectives over ICI within a slice and DCN across slices, replacing the
+reference's ssh-launched parameter-averaging master/worker topology.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """≙ the Ec2BoxCreator knobs, restated for TPU pods."""
+
+    name: str = "dl4j-tpu-cluster"
+    accelerator_type: str = "v4-32"        # pod slice (#chips = suffix)
+    runtime_version: str = "tpu-ubuntu2204-base"
+    zone: str = "us-central2-b"
+    project: Optional[str] = None
+    num_slices: int = 1                    # >1 = multislice (DCN between)
+
+    @property
+    def num_workers(self) -> int:
+        chips = int(self.accelerator_type.split("-")[-1])
+        return max(chips // 8, 1) * self.num_slices  # 8 chips per VM host
+
+    def create_command(self) -> List[str]:
+        """gcloud line that creates the queued resource (box creation)."""
+        cmd = [
+            "gcloud", "compute", "tpus", "tpu-vm", "create", self.name,
+            f"--accelerator-type={self.accelerator_type}",
+            f"--version={self.runtime_version}",
+            f"--zone={self.zone}",
+        ]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+    def ssh_command(self, worker: int, remote_cmd: str) -> List[str]:
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", self.name,
+            f"--zone={self.zone}", f"--worker={worker}",
+            "--command", remote_cmd,
+        ]
+
+
+class HostProvisioner:
+    """Generates the per-host provisioning steps (upload + run).
+    ≙ ``HostProvisioner.java`` (JSch scp/exec), expressed as command lines."""
+
+    def __init__(self, spec: ClusterSpec):
+        self.spec = spec
+
+    def upload_command(self, local_path: str, worker="all",
+                       remote_path: str = "~/") -> List[str]:
+        return [
+            "gcloud", "compute", "tpus", "tpu-vm", "scp", str(local_path),
+            f"{self.spec.name}:{remote_path}",
+            f"--zone={self.spec.zone}", f"--worker={worker}",
+        ]
+
+    def run_on_all(self, remote_cmd: str) -> List[List[str]]:
+        return [self.spec.ssh_command("all", remote_cmd)]
+
+
+class ClusterSetup:
+    """Wires a pod into a training cluster: writes the bootstrap script every
+    worker runs, plus the launch commands.  ≙ ``ClusterSetup.java`` +
+    ``DistributedDeepLearningTrainer.java`` bootstrap."""
+
+    def __init__(self, spec: ClusterSpec, train_module: str = "train"):
+        self.spec = spec
+        self.train_module = train_module
+
+    def bootstrap_script(self) -> str:
+        return (
+            "#!/usr/bin/env bash\n"
+            "# dl4j-tpu worker bootstrap — runs on every pod worker.\n"
+            "# jax.distributed discovers coordinator + process index from\n"
+            "# the TPU pod metadata; nothing to pass explicitly.\n"
+            "set -euo pipefail\n"
+            "python -m deeplearning4j_tpu.provision.cluster --bootstrap "
+            f"-- python -m {self.train_module}\n"
+        )
+
+    def write_bootstrap(self, directory) -> Path:
+        p = Path(directory) / "bootstrap.sh"
+        p.write_text(self.bootstrap_script())
+        p.chmod(0o755)
+        return p
+
+    def launch_commands(self) -> List[List[str]]:
+        """Everything needed to go from nothing to a training pod."""
+        prov = HostProvisioner(self.spec)
+        return (
+            [self.spec.create_command()]
+            + [prov.upload_command("bootstrap.sh", worker="all")]
+            + prov.run_on_all("bash ~/bootstrap.sh")
+        )
+
+
+def _on_tpu_pod() -> bool:
+    """Multi-worker TPU pod detection: the TPU runtime exports the worker
+    host list on every pod VM (absent on single-host and CPU)."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return "," in hosts  # >1 worker
+
+
+def bootstrap_distributed(coordinator: Optional[str] = None,
+                          num_processes: Optional[int] = None,
+                          process_id: Optional[int] = None) -> dict:
+    """Initialise jax.distributed from explicit args, environment
+    (DL4J_TPU_COORDINATOR / DL4J_TPU_NUM_PROCS / DL4J_TPU_PROC_ID), or — on
+    a real TPU pod — automatically from pod metadata.  Returns a summary
+    dict; no-op for a genuinely single-process launch."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.training_master import (
+        initialize_distributed,
+    )
+
+    coordinator = coordinator or os.environ.get("DL4J_TPU_COORDINATOR")
+    num_processes = num_processes if num_processes is not None else (
+        int(os.environ["DL4J_TPU_NUM_PROCS"])
+        if "DL4J_TPU_NUM_PROCS" in os.environ else None)
+    process_id = process_id if process_id is not None else (
+        int(os.environ["DL4J_TPU_PROC_ID"])
+        if "DL4J_TPU_PROC_ID" in os.environ else None)
+    if coordinator is None and num_processes is None:
+        if not _on_tpu_pod():
+            return {"distributed": False, "processes": 1, "process_id": 0}
+        # pod metadata carries coordinator/count/index; jax discovers them
+        initialize_distributed()
+    else:
+        initialize_distributed(coordinator, num_processes, process_id)
+    return {"distributed": True,
+            "processes": jax.process_count(),
+            "process_id": jax.process_index()}
+
+
+if __name__ == "__main__":  # pragma: no cover - pod-side entry
+    import subprocess
+    import sys
+
+    args = sys.argv[1:]
+    if args and args[0] == "--bootstrap":
+        bootstrap_distributed()
+        rest = args[1:]
+        if rest and rest[0] == "--":
+            rest = rest[1:]
+        if rest:
+            sys.exit(subprocess.call(rest))

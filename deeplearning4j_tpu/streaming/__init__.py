@@ -1,0 +1,10 @@
+from deeplearning4j_tpu.streaming.serde import (
+    array_to_base64, base64_to_array, dataset_to_json, dataset_from_json,
+    record_to_dataset,
+)
+from deeplearning4j_tpu.streaming.pubsub import (
+    MessageBroker, NDArrayPublisher, NDArrayConsumer,
+)
+from deeplearning4j_tpu.streaming.serving import (
+    InferenceServer, StreamingPipeline, ServingPipeline,
+)

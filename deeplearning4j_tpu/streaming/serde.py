@@ -1,0 +1,76 @@
+"""NDArray / DataSet wire serialization for the streaming layer.
+
+Reference: ``dl4j-streaming/.../serde/RecordSerializer.java`` plus the
+base64 NDArray encoding used by ``kafka/NDArrayPublisher.java`` /
+``NDArrayConsumer.java`` (arrays travel as base64 strings inside JSON
+messages).  Format here: little-endian float32 payload + explicit shape,
+JSON-framed, so any consumer can decode without this library.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+
+def array_to_base64(arr: np.ndarray) -> Dict[str, Any]:
+    """{'shape': [...], 'dtype': 'float32', 'data': <base64>} envelope."""
+    arr = np.ascontiguousarray(arr, np.float32)
+    return {
+        "shape": list(arr.shape),
+        "dtype": "float32",
+        "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+    }
+
+
+def base64_to_array(env: Dict[str, Any]) -> np.ndarray:
+    raw = base64.b64decode(env["data"])
+    return np.frombuffer(raw, np.float32).reshape(env["shape"]).copy()
+
+
+def dataset_to_json(ds: DataSet) -> str:
+    obj: Dict[str, Any] = {"features": array_to_base64(ds.features),
+                           "labels": array_to_base64(ds.labels)}
+    if ds.features_mask is not None:
+        obj["features_mask"] = array_to_base64(ds.features_mask)
+    if ds.labels_mask is not None:
+        obj["labels_mask"] = array_to_base64(ds.labels_mask)
+    return json.dumps(obj)
+
+
+def dataset_from_json(text: str) -> DataSet:
+    obj = json.loads(text)
+    return DataSet(
+        base64_to_array(obj["features"]),
+        base64_to_array(obj["labels"]),
+        base64_to_array(obj["features_mask"]) if "features_mask" in obj else None,
+        base64_to_array(obj["labels_mask"]) if "labels_mask" in obj else None,
+    )
+
+
+def record_to_dataset(record: Sequence[float], label_index: Optional[int],
+                      num_classes: Optional[int] = None,
+                      regression: bool = False) -> DataSet:
+    """Single record -> 1-example DataSet (the record-conversion step of
+    ``conversion/dataset/*`` in the reference streaming module)."""
+    vals = np.asarray(list(record), np.float32)
+    if label_index is None:
+        return DataSet(vals[None, :], np.zeros((1, 0), np.float32))
+    feat = np.concatenate([vals[:label_index], vals[label_index + 1:]])
+    if regression:
+        lab = vals[label_index:label_index + 1]
+    else:
+        if not num_classes:
+            raise ValueError("num_classes is required for classification "
+                             "records (regression=False)")
+        c = int(vals[label_index])
+        if not 0 <= c < num_classes:
+            raise ValueError(f"label value {c} outside [0, {num_classes})")
+        lab = np.zeros(num_classes, np.float32)
+        lab[c] = 1.0
+    return DataSet(feat[None, :], lab[None, :])

@@ -1,0 +1,260 @@
+"""Model serving + streaming pipelines.
+
+Reference: ``dl4j-streaming/.../routes/DL4jServeRouteBuilder.java`` (serve a
+trained model: consume records, predict, publish predictions back) and
+``pipeline/spark/SparkStreamingPipeline.java`` (Kafka -> record conversion ->
+DStream<DataSet> -> fit).  TPU redesign: the serving hot path batches queued
+requests before the jitted forward pass so the MXU sees full tiles instead
+of single rows, and pads to a fixed max batch so XLA never retraces.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.streaming.pubsub import MessageBroker
+from deeplearning4j_tpu.streaming.serde import (
+    array_to_base64, base64_to_array, record_to_dataset,
+)
+
+
+class InferenceServer:
+    """HTTP model server: POST /predict with an NDArray envelope (or a plain
+    JSON list) returns the model's output.  GET /healthz for liveness.
+
+    Requests that arrive concurrently are micro-batched: the handler thread
+    enqueues, a single dispatch thread pads the queue contents to
+    ``max_batch`` and runs ONE forward pass — TPU-friendly serving (large
+    static-shape batches) replacing the reference's per-message Camel route.
+    """
+
+    def __init__(self, model, max_batch: int = 32,
+                 max_wait_ms: float = 2.0, port: int = 0):
+        self.model = model
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._pending: list = []
+        self._lock = threading.Condition()
+        self._stop = False
+
+    # --------------------------------------------------------- micro-batcher
+    def _run_model(self, feats: np.ndarray) -> np.ndarray:
+        """Forward pass in fixed max_batch-shaped chunks: every call XLA
+        sees is exactly [max_batch, ...], so no request size ever retraces."""
+        outs = []
+        for i in range(0, len(feats), self.max_batch):
+            chunk = feats[i:i + self.max_batch]
+            n = len(chunk)
+            if n < self.max_batch:
+                pad = np.zeros((self.max_batch - n,) + chunk.shape[1:],
+                               chunk.dtype)
+                chunk = np.concatenate([chunk, pad])
+            outs.append(np.asarray(self.model.output(chunk))[:n])
+        return np.concatenate(outs)
+
+    def _dispatch_loop(self):
+        while True:
+            with self._lock:
+                while not self._pending and not self._stop:
+                    self._lock.wait(0.1)
+                if self._stop:
+                    # fail any stragglers instead of hanging their waiters
+                    for _f, done, result in self._pending:
+                        result.append(RuntimeError("server stopped"))
+                        done.set()
+                    self._pending.clear()
+                    return
+                self._lock.wait(self.max_wait_ms / 1000.0)
+                # take requests until the row budget is filled (a single
+                # oversized request is still taken alone and chunked)
+                batch, rows = [], 0
+                while self._pending and (not batch
+                                         or rows + len(self._pending[0][0])
+                                         <= self.max_batch):
+                    req = self._pending.pop(0)
+                    batch.append(req)
+                    rows += len(req[0])
+            try:
+                out = self._run_model(np.concatenate([b[0] for b in batch]))
+                pos = 0
+                for f, done, result in batch:
+                    result.append(out[pos:pos + len(f)])
+                    pos += len(f)
+                    done.set()
+            except Exception as e:  # deliver the failure to the waiters;
+                for _f, done, result in batch:  # the loop must survive
+                    result.append(e)
+                    done.set()
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        """Thread-safe enqueue + wait (used by the HTTP handler and usable
+        directly in-process)."""
+        features = np.asarray(features, np.float32)
+        if features.ndim == 1:
+            features = features[None, :]
+        done = threading.Event()
+        result: list = []
+        with self._lock:
+            self._pending.append((features, done, result))
+            self._lock.notify_all()
+        done.wait()
+        if isinstance(result[0], Exception):
+            raise result[0]
+        return result[0]
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> int:
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json({"status": "ok"})
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self.send_error(404)
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                obj = json.loads(self.rfile.read(n).decode())
+                if isinstance(obj, dict) and "data" in obj:
+                    feats = base64_to_array(obj)
+                else:
+                    feats = np.asarray(obj, np.float32)
+                try:
+                    out = server.predict(feats)
+                except Exception as e:  # surface model errors as 400s
+                    self._json({"error": str(e)}, code=400)
+                    return
+                self._json(array_to_base64(out))
+
+        self._stop = False
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True)
+        self._dispatcher.start()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self._requested_port),
+                                          Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self):
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class StreamingPipeline:
+    """Consume records from a broker topic, convert to DataSets, train.
+
+    ≙ ``SparkStreamingPipeline.java``: Kafka -> DataVec conversion ->
+    fit on each micro-batch.  Records are JSON lists on `topic`; every
+    `batch_size` records become one minibatch."""
+
+    def __init__(self, model, broker: MessageBroker, topic: str,
+                 label_index: int, num_classes: Optional[int] = None,
+                 regression: bool = False, batch_size: int = 32):
+        self.model = model
+        self.topic = topic
+        self.label_index = label_index
+        self.num_classes = num_classes
+        self.regression = regression
+        self.batch_size = batch_size
+        self._queue = broker.subscribe(topic)
+        self._stop = threading.Event()
+        self.batches_trained = 0
+
+    def _drain_batch(self, timeout: float):
+        examples = []
+        while len(examples) < self.batch_size and not self._stop.is_set():
+            try:
+                msg = self._queue.get(timeout=timeout)
+            except Exception:
+                break
+            examples.append(record_to_dataset(
+                json.loads(msg), self.label_index, self.num_classes,
+                self.regression))
+        return examples
+
+    def run(self, max_batches: Optional[int] = None, timeout: float = 1.0):
+        """Blocking consume-train loop; returns after `max_batches` or when
+        the topic stays quiet past `timeout`."""
+        while not self._stop.is_set():
+            examples = self._drain_batch(timeout)
+            if not examples:
+                return
+            ds = DataSet.merge(examples)
+            if len(ds) < self.batch_size:
+                ds = ds.pad_batch(self.batch_size)
+            self.model.fit(ds.features, ds.labels, lmask=ds.labels_mask)
+            self.batches_trained += 1
+            if max_batches and self.batches_trained >= max_batches:
+                return
+
+    def stop(self):
+        self._stop.set()
+
+
+class ServingPipeline:
+    """Consume feature records from `in_topic`, predict, publish predictions
+    to `out_topic`.  ≙ ``DL4jServeRouteBuilder.java`` (predictions published
+    back to a Kafka topic)."""
+
+    def __init__(self, model, broker: MessageBroker, in_topic: str,
+                 out_topic: str, transform: Optional[Callable] = None):
+        self.model = model
+        self.broker = broker
+        self.in_topic = in_topic
+        self.out_topic = out_topic
+        self.transform = transform
+        self._queue = broker.subscribe(in_topic)
+        self._stop = threading.Event()
+
+    def run(self, max_messages: Optional[int] = None, timeout: float = 1.0):
+        served = 0
+        while not self._stop.is_set():
+            try:
+                msg = self._queue.get(timeout=timeout)
+            except Exception:
+                return
+            feats = np.asarray(json.loads(msg), np.float32)
+            if feats.ndim == 1:
+                feats = feats[None, :]
+            if self.transform is not None:
+                feats = self.transform(feats)
+            out = np.asarray(self.model.output(feats))
+            self.broker.publish(self.out_topic,
+                                json.dumps(array_to_base64(out)))
+            served += 1
+            if max_messages and served >= max_messages:
+                return
+
+    def stop(self):
+        self._stop.set()

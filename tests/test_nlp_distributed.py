@@ -1,0 +1,88 @@
+"""Distributed embedding training (Spark Word2Vec analog).
+
+Oracle, per the reference test strategy (SURVEY.md §4): distributed
+training must be equivalent to single-machine math — here a 1-device mesh
+must reproduce the serial engine bitwise, and the full 8-device mesh must
+learn the same corpus structure."""
+
+from collections import Counter
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.nlp.distributed import (
+    DistributedWord2Vec, parallel_vocab_count,
+)
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+from tests.test_nlp import check_cluster_structure, synthetic_corpus
+
+
+def builder(cls, sentences, **kw):
+    b = (cls.Builder()
+         .iterate(sentences)
+         .layer_size(32)
+         .window_size(3)
+         .min_word_frequency(2)
+         .learning_rate(0.2)
+         .epochs(8)
+         .seed(1)
+         .batch_size(64))
+    return b
+
+
+def test_parallel_vocab_count_matches_serial():
+    sentences = synthetic_corpus(100)
+    tf = DefaultTokenizerFactory()
+    serial = Counter()
+    for s in sentences:
+        serial.update(tf.create(s).tokens())
+    assert parallel_vocab_count(sentences, tf, n_threads=4) == serial
+
+
+def test_one_device_mesh_matches_serial_word2vec():
+    sentences = synthetic_corpus(60)
+    serial = builder(Word2Vec, sentences).build().fit()
+    mesh1 = backend.default_mesh(devices=jax.devices()[:1])
+    dist = builder(DistributedWord2Vec, sentences).mesh(mesh1).build().fit()
+    np.testing.assert_allclose(np.asarray(serial.syn0),
+                               np.asarray(dist.syn0), atol=1e-5)
+
+
+def test_eight_device_mesh_matches_serial_word2vec():
+    # the count-weighted psum reconstruction makes sharded training compute
+    # the same global-mean update as the unsharded kernel (float
+    # reassociation aside) — the distributed==local oracle, on HS and NS
+    sentences = synthetic_corpus(60)
+    for hs, neg in ((True, 0), (False, 5)):
+        serial = (builder(Word2Vec, sentences)
+                  .use_hierarchic_softmax(hs).negative_sample(neg)
+                  .build().fit())
+        dist = (builder(DistributedWord2Vec, sentences)
+                .use_hierarchic_softmax(hs).negative_sample(neg)
+                .mesh(backend.default_mesh()).build().fit())
+        np.testing.assert_allclose(np.asarray(serial.syn0),
+                                   np.asarray(dist.syn0), atol=1e-4)
+
+
+def test_full_mesh_distributed_word2vec_learns_structure():
+    sentences = synthetic_corpus()
+    mesh = backend.default_mesh()
+    model = builder(DistributedWord2Vec, sentences).mesh(mesh).build().fit()
+    check_cluster_structure(model)
+    near = model.words_nearest("rain", top_n=4)
+    assert len(set(near) & {"snow", "storm", "cloud", "wind", "sun"}) >= 3
+
+
+def test_distributed_negative_sampling_learns_structure():
+    sentences = synthetic_corpus()
+    model = (builder(DistributedWord2Vec, sentences)
+             .use_hierarchic_softmax(False)
+             .negative_sample(5)
+             .epochs(12)
+             .mesh(backend.default_mesh())
+             .build().fit())
+    assert np.isfinite(model.cum_loss)
+    check_cluster_structure(model)

@@ -1,0 +1,63 @@
+"""Provisioning (deeplearning4j-aws analog) + interop (MLLibUtil analog)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.provision import (
+    ClusterSetup, ClusterSpec, HostProvisioner, bootstrap_distributed,
+)
+from deeplearning4j_tpu.utils import (
+    dataset_from_torch, dataset_to_labeled_points, dataset_to_torch,
+    from_torch, labeled_points_to_dataset, to_torch,
+)
+
+
+def test_cluster_spec_commands():
+    spec = ClusterSpec(name="c1", accelerator_type="v4-32", zone="z",
+                       project="p")
+    create = spec.create_command()
+    assert create[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create",
+                          "c1"]
+    assert "--accelerator-type=v4-32" in create and "--project=p" in create
+    assert spec.num_workers == 4  # 32 chips / 8 per host
+    ssh = spec.ssh_command(2, "echo hi")
+    assert "--worker=2" in ssh and ssh[-1] == "echo hi"
+
+
+def test_cluster_setup_bootstrap(tmp_path):
+    spec = ClusterSpec(num_slices=2)
+    setup = ClusterSetup(spec, train_module="myproj.train")
+    p = setup.write_bootstrap(tmp_path)
+    text = p.read_text()
+    assert "jax.distributed" in text and "myproj.train" in text
+    cmds = setup.launch_commands()
+    assert cmds[0][4] == "create"
+    assert any("bootstrap.sh" in " ".join(c) for c in cmds)
+    prov = HostProvisioner(spec)
+    up = prov.upload_command("model.zip", worker=1)
+    assert "scp" in up and "--worker=1" in up
+
+
+def test_bootstrap_distributed_single_process_noop():
+    out = bootstrap_distributed()
+    assert out == {"distributed": False, "processes": 1, "process_id": 0}
+
+
+def test_torch_interop_roundtrip():
+    rs = np.random.RandomState(0)
+    ds = DataSet(rs.rand(6, 3).astype(np.float32),
+                 np.eye(2, dtype=np.float32)[rs.randint(0, 2, 6)])
+    t = to_torch(ds.features)
+    np.testing.assert_allclose(from_torch(t), ds.features)
+    back = dataset_from_torch(dataset_to_torch(ds))
+    np.testing.assert_allclose(back.features, ds.features)
+    np.testing.assert_allclose(back.labels, ds.labels)
+
+
+def test_labeled_points_roundtrip():
+    pts = [([0.1, 0.2], 1), ([0.3, 0.4], 0)]
+    ds = labeled_points_to_dataset(pts, num_classes=2)
+    assert ds.labels[0, 1] == 1.0 and ds.labels[1, 0] == 1.0
+    back = dataset_to_labeled_points(ds)
+    assert back[0][1] == 1 and back[1][1] == 0
+    np.testing.assert_allclose(back[0][0], [0.1, 0.2], atol=1e-6)

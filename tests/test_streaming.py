@@ -1,0 +1,173 @@
+"""Streaming/serving layer (reference dl4j-streaming: Kafka pub/sub,
+serve routes, streaming train pipeline — Dl4jServingRouteTest pattern)."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.streaming import (
+    InferenceServer, MessageBroker, NDArrayConsumer, NDArrayPublisher,
+    ServingPipeline, StreamingPipeline, array_to_base64, base64_to_array,
+    dataset_from_json, dataset_to_json,
+)
+
+
+def small_net(n_in=2, n_out=2, seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater("sgd", learning_rate=0.5).list()
+            .layer(DenseLayer(n_in=n_in, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=n_out, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def test_array_serde_roundtrip():
+    a = np.random.RandomState(0).rand(3, 4, 5).astype(np.float32)
+    env = array_to_base64(a)
+    np.testing.assert_allclose(base64_to_array(env), a)
+
+
+def test_dataset_serde_roundtrip():
+    ds = DataSet(np.ones((2, 3), np.float32), np.zeros((2, 1), np.float32),
+                 labels_mask=np.array([1.0, 0.0], np.float32))
+    back = dataset_from_json(dataset_to_json(ds))
+    np.testing.assert_allclose(back.features, ds.features)
+    np.testing.assert_allclose(back.labels_mask, ds.labels_mask)
+    assert back.features_mask is None
+
+
+def test_pubsub_local():
+    broker = MessageBroker()
+    consumer = NDArrayConsumer("t1", broker=broker)
+    publisher = NDArrayPublisher("t1", broker=broker)
+    arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    publisher.publish(arr)
+    got = consumer.poll(timeout=2)
+    np.testing.assert_allclose(got, arr)
+    assert consumer.poll(timeout=0.05) is None
+
+
+def test_pubsub_http():
+    broker = MessageBroker()
+    port = broker.serve()
+    url = f"http://127.0.0.1:{port}"
+    pub = NDArrayPublisher("t2", url=url)
+    arr = np.array([[1.5, -2.0]], np.float32)
+
+    results = []
+    consumer = NDArrayConsumer("t2", url=url, sub_id="a")
+
+    def consume():
+        # first poll registers the HTTP subscription, may race the publish
+        results.append(consumer.poll(timeout=3))
+
+    # register the subscription before publishing
+    assert consumer.poll(timeout=0.2) is None
+    t = threading.Thread(target=consume)
+    t.start()
+    pub.publish(arr)
+    t.join(timeout=5)
+    broker.stop()
+    assert results and results[0] is not None
+    np.testing.assert_allclose(results[0], arr)
+
+
+def test_inference_server_batches_and_serves():
+    net = small_net()
+    server = InferenceServer(net, max_batch=8, port=0)
+    port = server.start()
+    url = f"http://127.0.0.1:{port}"
+    with urllib.request.urlopen(f"{url}/healthz", timeout=5) as r:
+        assert json.loads(r.read())["status"] == "ok"
+
+    # plain JSON list body
+    req = urllib.request.Request(
+        f"{url}/predict", data=json.dumps([[0.1, 0.9], [0.8, 0.2]]).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        out = base64_to_array(json.loads(r.read()))
+    assert out.shape == (2, 2)
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+    # concurrent requests micro-batch through one forward pass
+    outs = [None] * 6
+
+    def hit(i):
+        outs[i] = server.predict(np.array([0.1 * i, 0.5], np.float32))
+
+    threads = [threading.Thread(target=hit, args=(i,)) for i in range(6)]
+    [t.start() for t in threads]
+    [t.join(timeout=10) for t in threads]
+    assert all(o is not None and o.shape == (1, 2) for o in outs)
+    server.stop()
+
+
+def test_inference_server_survives_bad_request():
+    net = small_net()
+    server = InferenceServer(net, max_batch=4, port=0)
+    server.start()
+    import pytest
+
+    with pytest.raises(Exception):
+        server.predict(np.zeros((1, 7), np.float32))  # wrong width
+    # dispatcher must still be alive for good requests
+    out = server.predict(np.zeros((1, 2), np.float32))
+    assert out.shape == (1, 2)
+    # oversized request chunks through the fixed batch shape
+    big = server.predict(np.zeros((11, 2), np.float32))
+    assert big.shape == (11, 2)
+    server.stop()
+
+
+def test_publish_never_blocks_on_slow_consumer():
+    broker = MessageBroker(queue_size=4)
+    q = broker.subscribe("slow")
+    for i in range(20):  # would deadlock with a blocking put
+        broker.publish("slow", str(i))
+    # oldest messages dropped, newest retained
+    got = [q.get_nowait() for _ in range(q.qsize())]
+    assert got[-1] == "19" and len(got) == 4
+
+
+def test_record_to_dataset_validation():
+    import pytest
+
+    from deeplearning4j_tpu.streaming.serde import record_to_dataset
+
+    with pytest.raises(ValueError, match="num_classes"):
+        record_to_dataset([1.0, 2.0, 0.0], label_index=2)
+    with pytest.raises(ValueError, match="outside"):
+        record_to_dataset([1.0, 2.0, 9.0], label_index=2, num_classes=3)
+
+
+def test_streaming_pipeline_trains():
+    net = small_net()
+    broker = MessageBroker()
+    pipe = StreamingPipeline(net, broker, "records", label_index=2,
+                             num_classes=2, batch_size=4)
+    rs = np.random.RandomState(0)
+    for _ in range(8):
+        a, b = rs.rand(2)
+        broker.publish("records", json.dumps([a, b, int(a + b > 1)]))
+    pipe.run(max_batches=2, timeout=0.2)
+    assert pipe.batches_trained == 2
+    assert np.isfinite(net.score_value)
+
+
+def test_serving_pipeline_round_trip():
+    net = small_net()
+    broker = MessageBroker()
+    out_q = broker.subscribe("preds")
+    pipe = ServingPipeline(net, broker, "features", "preds")
+    broker.publish("features", json.dumps([0.2, 0.7]))
+    pipe.run(max_messages=1, timeout=1.0)
+    msg = out_q.get(timeout=2)
+    pred = base64_to_array(json.loads(msg))
+    assert pred.shape == (1, 2)
