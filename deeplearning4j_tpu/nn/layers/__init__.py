@@ -25,3 +25,4 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
     RnnOutputLayer,
 )
 from deeplearning4j_tpu.nn.layers.autoencoder import AutoEncoder, RBM
+from deeplearning4j_tpu.nn.layers.moe import MoELayer

@@ -1,0 +1,114 @@
+"""Mixture-of-Experts layer — expert parallelism (EP) building block.
+
+Beyond-reference extension (the reference predates MoE; SURVEY.md §2 lists
+EP as absent).  TPU-first design: top-1 "switch" routing with a fixed
+per-expert capacity so every shape is static — dispatch and combine are
+one-hot einsums that lower to MXU matmuls, and the expert dimension of
+every parameter is sharded over the mesh's model axis by the tensor/expert
+parallel training master (``parallel/model_parallel.py``), putting each
+expert's FFN on its own chips with all-to-all dispatch inserted by GSPMD.
+
+Tokens over a full expert's capacity are dropped (contribute the residual
+path only) — standard Switch-Transformer semantics that keeps the program
+shape-static under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import activations, initializers
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class MoELayer(Layer):
+    """Switch-routed expert FFN: x -> router -> expert MLP -> combine.
+
+    n_in/n_out: model width (input preserved: experts are hidden FFNs with a
+    residual add, transformer-style).  hidden: per-expert FFN width.
+    """
+
+    n_in: Optional[int] = None
+    n_out: Optional[int] = None
+    num_experts: int = 4
+    hidden: int = 0                   # default 4*n_in
+    capacity_factor: float = 1.25
+    activation: str = "relu"
+    residual: bool = True
+
+    def setup(self, input_type: InputType) -> "MoELayer":
+        n_in = self.n_in if self.n_in is not None else input_type.flat_size()
+        n_out = self.n_out if self.n_out is not None else n_in
+        return dataclasses.replace(self, n_in=n_in, n_out=n_out)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def validate(self) -> None:
+        super().validate()
+        if self.residual and self.n_in != self.n_out:
+            raise ValueError("MoE residual path needs n_in == n_out")
+
+    def init(self, key, dtype=jnp.float32) -> Dict[str, jax.Array]:
+        h = self.hidden or 4 * self.n_in
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        E = self.num_experts
+
+        def w(k, shape, fan_in, fan_out):
+            return initializers.init(self.weight_init, k, shape, dtype,
+                                     fan_in=fan_in, fan_out=fan_out)
+
+        return {
+            "W_router": w(k1, (self.n_in, E), self.n_in, E),
+            "W_up": w(k2, (E, self.n_in, h), self.n_in, h),
+            "b_up": jnp.zeros((E, h), dtype),
+            "W_down": w(k3, (E, h, self.n_out), h, self.n_out),
+            "b_down": jnp.zeros((E, self.n_out), dtype),
+        }
+
+    def _capacity(self, n_tokens: int) -> int:
+        return max(1, int(self.capacity_factor * n_tokens
+                          / self.num_experts))
+
+    def apply(self, params, state, x, *, train=False, rng=None):
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        orig_shape = x.shape
+        tokens = x.reshape(-1, orig_shape[-1])           # [T, d]
+        T = tokens.shape[0]
+        E = self.num_experts
+        C = self._capacity(T)
+
+        logits = tokens @ params["W_router"]             # [T, E]
+        gates = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(gates, axis=-1)              # [T]
+        gate = jnp.take_along_axis(gates, expert[:, None], 1)[:, 0]
+
+        # position of each token within its expert's capacity buffer
+        onehot = jax.nn.one_hot(expert, E, dtype=tokens.dtype)   # [T, E]
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot        # [T, E]
+        in_cap = (pos < C) & (onehot > 0)                        # [T, E]
+        # dispatch tensor [T, E, C]: token t -> slot (e, c)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=tokens.dtype) * in_cap[..., None]
+        expert_in = jnp.einsum("tec,td->ecd", slot, tokens)      # [E, C, d]
+
+        act = activations.get(self.activation)
+        hdn = act(jnp.einsum("ecd,edh->ech", expert_in, params["W_up"])
+                  + params["b_up"][:, None, :])
+        out = (jnp.einsum("ech,eho->eco", hdn, params["W_down"])
+               + params["b_down"][:, None, :])                   # [E, C, o]
+
+        combined = jnp.einsum("tec,eco->to", slot, out)          # [T, o]
+        combined = combined * gate[:, None]
+        if self.residual:
+            combined = combined + tokens
+        return combined.reshape(orig_shape[:-1] + (self.n_out,)), state

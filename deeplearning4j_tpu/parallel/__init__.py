@@ -11,3 +11,11 @@ from deeplearning4j_tpu.parallel.sequence_parallel import (
     ring_self_attention,
     ulysses_attention,
 )
+from deeplearning4j_tpu.parallel.model_parallel import (
+    TensorParallelTrainingMaster,
+    tensor_parallel_spec,
+)
+from deeplearning4j_tpu.parallel.pipeline import (
+    PipelineParallelTrainingMaster,
+    split_stages,
+)

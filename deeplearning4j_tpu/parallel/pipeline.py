@@ -1,0 +1,220 @@
+"""Pipeline parallelism — GPipe-style stage placement with microbatching.
+
+Beyond-reference extension (SURVEY.md §2: PP absent in the reference).
+
+Design: the layer stack is split into S stages balanced by parameter
+count; stage s's parameters live on device s.  A global batch is cut into
+M microbatches; the forward enqueues (microbatch, stage) work in schedule
+order and JAX's async dispatch overlaps them — while microbatch m runs on
+stage s, microbatch m+1 runs on stage s-1, exactly the GPipe fill/drain
+diagram, with activation transfers riding ICI on real hardware.  The
+backward replays the schedule in reverse through stored ``jax.vjp``
+pullbacks, accumulating per-stage gradients on their home devices; the
+updater then applies per stage with no cross-device parameter traffic.
+
+Scope: sequential stateless nets (no BatchNorm running stats, no masks,
+no TBPTT) — conv/dense/activation/attention/layernorm stacks.  Compose
+with DP/TP by using those masters; this one owns the pipe axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.optimize import updaters as upd
+from deeplearning4j_tpu.parallel.training_master import TrainingMaster
+
+
+def split_stages(net, n_stages: int) -> List[List[int]]:
+    """Partition layer indices into n_stages contiguous groups, balanced by
+    parameter count (the reference has no analog; think layer-to-executor
+    assignment)."""
+    counts = []
+    for layer in net.layers:
+        lp = net.params.get(layer.name, {})
+        counts.append(sum(int(np.prod(a.shape)) for a in lp.values()) or 1)
+    total = sum(counts)
+    target = total / n_stages
+    stages: List[List[int]] = [[]]
+    acc = 0.0
+    for i, c in enumerate(counts):
+        remaining_layers = len(counts) - i
+        remaining_stages = n_stages - len(stages) + 1
+        if (acc >= target and len(stages) < n_stages
+                and remaining_layers >= remaining_stages):
+            stages.append([])
+            acc = 0.0
+        stages[-1].append(i)
+        acc += c
+    while len(stages) < n_stages:  # degenerate tiny nets
+        stages.append([stages[-1].pop()] if len(stages[-1]) > 1 else [])
+    return [s for s in stages if s]
+
+
+class PipelineParallelTrainingMaster(TrainingMaster):
+    def __init__(self, n_stages: Optional[int] = None,
+                 n_microbatches: int = 4,
+                 devices: Optional[Sequence] = None):
+        self.devices = list(devices if devices is not None else jax.devices())
+        self.n_stages = n_stages or len(self.devices)
+        if self.n_stages > len(self.devices):
+            raise ValueError(
+                f"{self.n_stages} stages > {len(self.devices)} devices")
+        self.n_microbatches = n_microbatches
+        self._built = False
+
+    # ------------------------------------------------------------ validation
+    def _validate(self, net):
+        if net.conf.backprop_type == "truncated_bptt":
+            raise ValueError("pipeline master does not support TBPTT")
+        for layer in net.layers:
+            if layer.init_state():
+                raise ValueError(
+                    f"pipeline master needs stateless layers; '{layer.name}' "
+                    f"({type(layer).__name__}) carries state")
+            if layer.dropout > 0:
+                raise ValueError("pipeline master does not support dropout")
+
+    # ------------------------------------------------------------- stage fns
+    def _build(self, net):
+        self._validate(net)
+        self.stages = split_stages(net, self.n_stages)
+        self.stage_layers = [[net.layers[i] for i in s] for s in self.stages]
+        out_layer = net.layers[-1]
+
+        def make_stage_fwd(layers):
+            def fwd(stage_params, a):
+                for layer in layers:
+                    if layer.has_params():
+                        a, _ = layer.apply(stage_params[layer.name], {}, a,
+                                           train=True, rng=None)
+                    else:
+                        a, _ = layer.apply({}, {}, a, train=True, rng=None)
+                return a
+            return fwd
+
+        def make_last_stage(layers):
+            body = layers[:-1]
+
+            def fwd_loss(stage_params, a, y):
+                for layer in body:
+                    p = stage_params.get(layer.name, {})
+                    a, _ = layer.apply(p, {}, a, train=True, rng=None)
+                return out_layer.score(stage_params[out_layer.name], a, y)
+            return fwd_loss
+
+        self._stage_fwds = [jax.jit(make_stage_fwd(ls))
+                            for ls in self.stage_layers[:-1]]
+        self._last_stage = jax.jit(make_last_stage(self.stage_layers[-1]))
+        self._reg_fns = [
+            jax.jit(jax.grad(lambda sp, ls=ls: sum(
+                layer.reg_score(sp.get(layer.name, {})) for layer in ls)))
+            for ls in self.stage_layers
+        ]
+        cfg = net.conf.updater
+        self._lr_overrides = {
+            l.name: l.learning_rate for l in net.layers
+            if l.learning_rate is not None
+        }
+        self._upd_cfg = cfg
+        self._built = True
+
+    def _stage_params(self, net, s: int) -> Dict[str, Any]:
+        names = [net.layers[i].name for i in self.stages[s]]
+        return {n: net.params[n] for n in names if n in net.params}
+
+    # ---------------------------------------------------------------- train
+    def execute_training(self, net, iterator):
+
+        if not self._built:
+            self._build(net)
+        S = len(self.stages)
+        # place each stage's params + updater state on its device
+        stage_params = [
+            jax.device_put(self._stage_params(net, s), self.devices[s])
+            for s in range(S)
+        ]
+        stage_upd = [
+            jax.device_put(
+                {slot: {n: tree[n] for n in stage_params[s] if n in tree}
+                 for slot, tree in net.updater_state.items()},
+                self.devices[s])
+            for s in range(S)
+        ]
+
+        for ds in iterator:
+            loss = self._train_batch(net, ds, stage_params, stage_upd)
+            net.score_value = float(loss)
+            net.iteration += 1
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration)
+        # merge stage params back
+        for s in range(S):
+            for name, p in stage_params[s].items():
+                net.params[name] = jax.device_put(p, self.devices[0])
+        for slot in net.updater_state:
+            merged = {}
+            for s in range(S):
+                merged.update(stage_upd[s][slot])
+            net.updater_state[slot] = {
+                n: jax.device_put(v, self.devices[0])
+                for n, v in merged.items()}
+
+    def _train_batch(self, net, ds, stage_params, stage_upd):
+        if ds.features_mask is not None or ds.labels_mask is not None:
+            raise ValueError("pipeline master does not support masked batches")
+        S = len(self.stages)
+        M = self.n_microbatches
+        x = jnp.asarray(ds.features)
+        y = jnp.asarray(ds.labels)
+        if len(x) % M:
+            raise ValueError(f"batch {len(x)} not divisible by "
+                             f"{M} microbatches")
+        xs = jnp.split(x, M)
+        ys = jnp.split(y, M)
+
+        # forward (fill): async dispatch overlaps (m, s) with (m+1, s-1)
+        pullbacks = [[None] * S for _ in range(M)]
+        losses = []
+        for m in range(M):
+            a = jax.device_put(xs[m], self.devices[0])
+            for s in range(S - 1):
+                a, vjp = jax.vjp(self._stage_fwds[s], stage_params[s], a)
+                pullbacks[m][s] = vjp
+                a = jax.device_put(a, self.devices[s + 1])
+            y_m = jax.device_put(ys[m], self.devices[S - 1])
+            loss_m, vjp = jax.vjp(self._last_stage, stage_params[S - 1], a,
+                                  y_m)
+            pullbacks[m][S - 1] = vjp
+            losses.append(loss_m)
+
+        # backward (drain), reverse schedule; grads accumulate per stage
+        grads = [None] * S
+        for m in range(M):
+            seed = jnp.ones((), losses[m].dtype) / M
+            gp, ga, _gy = pullbacks[m][S - 1](seed)
+            grads[S - 1] = gp if grads[S - 1] is None else jax.tree_util.tree_map(
+                jnp.add, grads[S - 1], gp)
+            for s in range(S - 2, -1, -1):
+                ga = jax.device_put(ga, self.devices[s])
+                gp, ga = pullbacks[m][s](ga)
+                grads[s] = gp if grads[s] is None else jax.tree_util.tree_map(
+                    jnp.add, grads[s], gp)
+
+        # regularization gradients + updater apply, per stage on-device
+        it = jnp.asarray(float(net.iteration))
+        for s in range(S):
+            g = jax.tree_util.tree_map(
+                jnp.add, grads[s], self._reg_fns[s](stage_params[s]))
+            updates, stage_upd[s] = upd.update(
+                self._upd_cfg, g, stage_upd[s], it, self._lr_overrides)
+            stage_params[s] = {
+                ln: (upd.apply_updates(stage_params[s][ln], u)
+                     if (u := updates.get(ln)) else stage_params[s][ln])
+                for ln in stage_params[s]
+            }
+        return sum(jax.device_get(l) for l in losses) / M

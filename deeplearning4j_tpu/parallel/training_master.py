@@ -75,6 +75,12 @@ class SyncTrainingMaster(TrainingMaster):
         self._stats: Dict[str, Any] = {"steps": 0, "step_time_ms": []}
         self._step = None
 
+    def _param_layout(self, net):
+        """Sharding (single or per-param pytree) for the parameters.  Base:
+        fully replicated.  TensorParallelTrainingMaster overrides this with
+        model-axis shardings — the jitted step is otherwise identical."""
+        return NamedSharding(self.mesh, P())
+
     def _build(self, net):
         cfg = net.conf.updater
         lr_overrides = {
@@ -83,6 +89,18 @@ class SyncTrainingMaster(TrainingMaster):
         mesh = self.mesh
         repl = NamedSharding(mesh, P())
         data = NamedSharding(mesh, P(backend.AXIS_DATA))
+        players = self._param_layout(net)
+        # updater state mirrors the param tree per slot ({"m": ..., "v": ...})
+        # but only over TRAINABLE layers — restrict to the state's own keys
+        if isinstance(players, dict) and net.updater_state:
+            ulayers: Any = {
+                slot: {ln: players[ln] for ln in tree}
+                for slot, tree in net.updater_state.items()
+            }
+        elif isinstance(players, dict):
+            ulayers = repl
+        else:
+            ulayers = players
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
             (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
@@ -97,15 +115,18 @@ class SyncTrainingMaster(TrainingMaster):
             }
             return new_params, new_us, new_ns, loss
 
-        in_shardings = (repl, repl, repl, repl, data, data, repl, data, data)
+        in_shardings = (players, ulayers, repl, repl, data, data, repl, data,
+                        data)
         self._step = jax.jit(
             step,
             in_shardings=in_shardings,
-            out_shardings=(repl, repl, repl, repl),
+            out_shardings=(players, ulayers, repl, repl),
             donate_argnums=(0, 1, 2),
         )
         self._data_sharding = data
         self._repl_sharding = repl
+        self._params_layout = players
+        self._upd_layout = ulayers
 
     def execute_training(self, net, iterator):
         import time
@@ -116,8 +137,8 @@ class SyncTrainingMaster(TrainingMaster):
             iterator = AsyncDataSetIterator(iterator, self.prefetch_size)
         if self._step is None:
             self._build(net)
-        params = jax.device_put(net.params, self._repl_sharding)
-        upd_state = jax.device_put(net.updater_state, self._repl_sharding)
+        params = jax.device_put(net.params, self._params_layout)
+        upd_state = jax.device_put(net.updater_state, self._upd_layout)
         ns = jax.device_put(net.net_state, self._repl_sharding)
         K = self.mesh.shape[backend.AXIS_DATA]
         for ds in iterator:
@@ -198,8 +219,9 @@ class DistributedNetwork:
         self.net = net
         self.master = training_master
 
-    def fit(self, iterator):
-        self.master.execute_training(self.net, iterator)
+    def fit(self, iterator, epochs: int = 1):
+        for _ in range(epochs):
+            self.master.execute_training(self.net, iterator)
         return self.net
 
     def evaluate(self, iterator, evaluation=None):

@@ -1,0 +1,202 @@
+"""Tensor / pipeline / expert parallelism.
+
+Oracle, as everywhere (SURVEY.md §4): parallel training must equal local
+sequential math — TP shards the same program (bitwise-close), PP is exact
+GPipe grad accumulation, MoE is checked for routing mass conservation and
+trainability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalization, DenseLayer, MoELayer, OutputLayer,
+)
+from deeplearning4j_tpu.parallel import (
+    DistributedNetwork, PipelineParallelTrainingMaster,
+    TensorParallelTrainingMaster, split_stages, tensor_parallel_spec,
+)
+
+
+def mlp(seed=3, updater="adam", lr=0.05, widths=(8, 16, 16, 4)):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater, learning_rate=lr).list())
+    for i in range(len(widths) - 2):
+        b = b.layer(DenseLayer(n_in=widths[i], n_out=widths[i + 1],
+                               activation="tanh"))
+    b = b.layer(OutputLayer(n_in=widths[-2], n_out=widths[-1], loss="mcxent",
+                            activation="softmax"))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def data(n=32, n_in=8, n_out=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return (rs.rand(n, n_in).astype(np.float32),
+            np.eye(n_out, dtype=np.float32)[rs.randint(0, n_out, n)])
+
+
+def test_tensor_parallel_spec_alternates():
+    net = mlp()
+    spec = tensor_parallel_spec(net.params, tp=2)
+    from jax.sharding import PartitionSpec as P
+
+    assert spec["layer_0"]["W"] == P(None, "model")
+    assert spec["layer_1"]["W"] == P("model", None)
+    assert spec["layer_2"]["W"] == P(None, "model")
+    assert spec["layer_0"]["b"] == P()
+
+
+def test_tensor_parallel_matches_serial():
+    x, y = data()
+    serial = mlp()
+    serial.fit(ListDataSetIterator(DataSet(x, y), 16), epochs=2)
+
+    tp_net = mlp()
+    mesh = backend.default_mesh(data=4, model=2)
+    master = TensorParallelTrainingMaster(mesh=mesh)
+    DistributedNetwork(tp_net, master).fit(
+        ListDataSetIterator(DataSet(x, y), 16), epochs=2)
+    for ln in serial.params:
+        for pn in serial.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[ln][pn]),
+                np.asarray(tp_net.params[ln][pn]), atol=2e-5,
+                err_msg=f"{ln}/{pn}")
+
+
+def test_split_stages_balanced_and_contiguous():
+    net = mlp(widths=(8, 32, 32, 32, 4))
+    stages = split_stages(net, 2)
+    assert [i for s in stages for i in s] == list(range(len(net.layers)))
+    assert len(stages) == 2 and all(stages)
+
+
+def test_pipeline_matches_serial():
+    x, y = data()
+    serial = mlp(updater="sgd", lr=0.5)
+    serial.fit(ListDataSetIterator(DataSet(x, y), 16), epochs=2)
+
+    pp_net = mlp(updater="sgd", lr=0.5)
+    master = PipelineParallelTrainingMaster(n_stages=3, n_microbatches=4,
+                                            devices=jax.devices()[:3])
+    DistributedNetwork(pp_net, master).fit(
+        ListDataSetIterator(DataSet(x, y), 16), epochs=2)
+    for ln in serial.params:
+        for pn in serial.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[ln][pn]),
+                np.asarray(pp_net.params[ln][pn]), atol=2e-5,
+                err_msg=f"{ln}/{pn}")
+    assert abs(serial.score_value - pp_net.score_value) < 1e-4
+
+
+def test_pipeline_rejects_stateful_layers():
+    b = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+         .layer(DenseLayer(n_in=4, n_out=8))
+         .layer(BatchNormalization(n_out=8))
+         .layer(OutputLayer(n_in=8, n_out=2)))
+    net = MultiLayerNetwork(b.build()).init()
+    master = PipelineParallelTrainingMaster(n_stages=2,
+                                            devices=jax.devices()[:2])
+    x, y = data(8, 4, 2)
+    with pytest.raises(ValueError, match="stateless"):
+        DistributedNetwork(net, master).fit(
+            ListDataSetIterator(DataSet(x, y), 8))
+
+
+def test_moe_layer_forward_and_training():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater("adam", learning_rate=0.02).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(MoELayer(n_in=16, n_out=16, num_experts=4,
+                            capacity_factor=2.0))
+            .layer(OutputLayer(n_in=16, n_out=4, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x, y = data(64, 8, 4)
+    s0 = net.score(x, y)
+    for _ in range(30):
+        net.fit(x, y)
+    assert net.score(x, y) < s0 * 0.8
+    out = np.asarray(net.output(x))
+    np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+def test_moe_expert_sharding_under_tp():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater("sgd", learning_rate=0.1).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+            .layer(MoELayer(n_in=16, n_out=16, num_experts=4,
+                            capacity_factor=2.0))
+            .layer(OutputLayer(n_in=16, n_out=4))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    spec = tensor_parallel_spec(net.params, tp=2)
+    from jax.sharding import PartitionSpec as P
+
+    assert spec["layer_1"]["W_up"] == P("model", None, None)
+    assert spec["layer_1"]["W_down"] == P("model", None, None)
+    mesh = backend.default_mesh(data=4, model=2)
+    master = TensorParallelTrainingMaster(mesh=mesh)
+    x, y = data(32, 8, 4)
+    DistributedNetwork(net, master).fit(ListDataSetIterator(DataSet(x, y), 16))
+    assert np.isfinite(net.score_value)
+
+
+def test_tp_and_pp_with_paramless_layers_and_stateful_updater():
+    # regression: updater-state sharding/placement must track the TRAINABLE
+    # tree, which omits param-less layers (ActivationLayer etc.)
+    from deeplearning4j_tpu.nn.layers import ActivationLayer
+
+    def build():
+        return MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().seed(4)
+             .updater("adam", learning_rate=0.02).list()
+             .layer(DenseLayer(n_in=8, n_out=16))
+             .layer(ActivationLayer(activation="relu"))
+             .layer(OutputLayer(n_in=16, n_out=4)).build())).init()
+
+    x, y = data(16, 8, 4)
+    tp_net = build()
+    DistributedNetwork(
+        tp_net, TensorParallelTrainingMaster(
+            mesh=backend.default_mesh(data=4, model=2))
+    ).fit(ListDataSetIterator(DataSet(x, y), 16))
+    assert np.isfinite(tp_net.score_value)
+
+    pp_net = build()
+    DistributedNetwork(
+        pp_net, PipelineParallelTrainingMaster(
+            n_stages=2, n_microbatches=2, devices=jax.devices()[:2])
+    ).fit(ListDataSetIterator(DataSet(x, y), 16))
+    assert np.isfinite(pp_net.score_value)
+
+
+def test_moe_width_inference_from_input_type():
+    from deeplearning4j_tpu.nn.inputs import InputType
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.1).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(MoELayer(num_experts=2, capacity_factor=2.0))
+            .layer(OutputLayer(n_out=4))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert net.params["layer_1"]["W_router"].shape == (16, 2)
+    x, y = data(8, 8, 4)
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+
+
+def test_moe_validation():
+    with pytest.raises(ValueError, match="n_in == n_out"):
+        (NeuralNetConfiguration.builder().list()
+         .layer(MoELayer(n_in=8, n_out=4))
+         .layer(OutputLayer(n_in=4, n_out=2)).build())
