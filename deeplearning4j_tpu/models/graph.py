@@ -110,6 +110,18 @@ class GraphConfiguration:
                 )
         self.topological_order()
 
+    def to_yaml(self) -> str:
+        """YAML form (reference ComputationGraphConfiguration YAML mapper)."""
+        import yaml
+
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "GraphConfiguration":
+        import yaml
+
+        return GraphConfiguration.from_json(json.dumps(yaml.safe_load(s)))
+
     def to_json(self) -> str:
         return json.dumps(
             {

@@ -45,6 +45,13 @@ from deeplearning4j_tpu.nlp.vocab import (
 from deeplearning4j_tpu.nlp.word2vec import StaticWord2Vec, Word2Vec
 from deeplearning4j_tpu.nlp.wordvectors import WordVectors
 from deeplearning4j_tpu.nlp import serializer as WordVectorSerializer
+from deeplearning4j_tpu.nlp.stopwords import (
+    StopWordsRemover, get_stop_words, is_stop_word, remove_stop_words,
+)
+from deeplearning4j_tpu.nlp.annotation import (
+    TextAnnotator, pos_tag, sentiment_score, split_sentences,
+)
+from deeplearning4j_tpu.nlp.windows import Window, windows
 
 __all__ = [
     "BagOfWordsVectorizer", "TfidfVectorizer", "AggregatingSentenceIterator",
@@ -57,4 +64,7 @@ __all__ = [
     "TokenizerFactory", "Sequence", "SequenceElement", "VocabCache",
     "VocabConstructor", "VocabWord", "build_huffman", "codes_matrix",
     "StaticWord2Vec", "Word2Vec", "WordVectors", "WordVectorSerializer",
+    "StopWordsRemover", "get_stop_words", "is_stop_word",
+    "remove_stop_words", "TextAnnotator", "pos_tag", "sentiment_score",
+    "split_sentences", "Window", "windows",
 ]

@@ -13,11 +13,8 @@ from typing import Callable, List, Optional
 
 # Compact english stopword list (reference ships one as a resource file;
 # text/stopwords — same role, trimmed to the common core).
-STOP_WORDS = frozenset("""a an and are as at be but by for if in into is it no
-not of on or such that the their then there these they this to was will with
-he she his her him you your i we our us me my so do does did done been being
-have has had am what which who whom when where why how all any both each few
-more most other some than too very can just should now""".split())
+# single authoritative stoplist (see nlp/stopwords.py, ≙ StopWords.java)
+from deeplearning4j_tpu.nlp.stopwords import ENGLISH as STOP_WORDS
 
 
 class TokenPreProcess:

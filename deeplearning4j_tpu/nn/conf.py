@@ -127,6 +127,18 @@ class MultiLayerConfiguration:
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """YAML form (reference ``MultiLayerConfiguration.toYaml`` :75)."""
+        import yaml
+
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        import yaml
+
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))
+
 
 class ListBuilder:
     """Layer-stack builder (reference ``NeuralNetConfiguration.ListBuilder``)."""
@@ -178,7 +190,6 @@ class ListBuilder:
         cur_type = self._input_type
         for i, layer in enumerate(self._layers):
             layer = p._apply_global_defaults(layer)
-            layer.validate()
             if layer.name is None:
                 layer = layer.with_name(f"layer_{i}")
             if cur_type is not None:
@@ -197,6 +208,8 @@ class ListBuilder:
                         f"Layer {i} ({type(layer).__name__}) has no n_in and no "
                         f"input_type was set for inference"
                     )
+            # validate AFTER setup so checks see inferred sizes
+            layer.validate()
             layers.append(layer)
         return MultiLayerConfiguration(
             layers=tuple(layers),

@@ -67,6 +67,18 @@ class OutputLayer(DenseLayer):
     def validate(self) -> None:
         super().validate()
         losses.get(self.loss)
+        if self.loss == "mcxent" and self.activation == "sigmoid":
+            import warnings
+
+            # mcxent lacks the (1-y)log(1-p) term, so with independent
+            # sigmoid outputs the loss is minimised by saturating ALL units
+            # to 1 — training silently degenerates (later reference versions
+            # warn on this exact pairing too)
+            warnings.warn(
+                "OutputLayer: loss 'mcxent' with activation 'sigmoid' "
+                "degenerates (all outputs ->1). Use activation='softmax' "
+                "for classification or loss='xent' for multi-label.",
+                stacklevel=2)
 
     def score(self, params, x, labels, mask=None):
         pre = self.pre_output(params, x)

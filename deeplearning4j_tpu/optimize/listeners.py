@@ -78,3 +78,39 @@ class ComposableIterationListener(IterationListener):
     def iteration_done(self, model, iteration):
         for l in self.listeners:
             l.iteration_done(model, iteration)
+
+
+class ProfilerListener(IterationListener):
+    """Captures a JAX/XLA profiler trace (XPlane + TensorBoard format) over
+    iterations [start, start+duration).  The tracing analog of SURVEY.md §5:
+    the reference has only wall-clock listeners; on TPU the XLA profile
+    shows per-op device time, HBM traffic and fusion decisions.
+
+    View with: ``tensorboard --logdir <log_dir>`` (Profile tab), or any
+    XPlane consumer."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 5,
+                 duration: int = 5):
+        self.log_dir = str(log_dir)
+        self.start = start_iteration
+        self.end = start_iteration + duration
+        self._active = False
+
+    def iteration_done(self, model, iteration):
+        import jax
+
+        if iteration >= self.start and not self._active and iteration < self.end:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif iteration >= self.end and self._active:
+            # block so the captured window contains finished device work
+            jax.block_until_ready(model.params)
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def stop(self):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False

@@ -37,22 +37,26 @@ def split_stages(net, n_stages: int) -> List[List[int]]:
     for layer in net.layers:
         lp = net.params.get(layer.name, {})
         counts.append(sum(int(np.prod(a.shape)) for a in lp.values()) or 1)
+    n_stages = min(n_stages, len(counts))
     total = sum(counts)
     target = total / n_stages
     stages: List[List[int]] = [[]]
     acc = 0.0
     for i, c in enumerate(counts):
-        remaining_layers = len(counts) - i
-        remaining_stages = n_stages - len(stages) + 1
-        if (acc >= target and len(stages) < n_stages
-                and remaining_layers >= remaining_stages):
-            stages.append([])
-            acc = 0.0
+        layers_left = len(counts) - i          # including this one
+        stages_to_open = n_stages - len(stages)
+        if stages[-1]:
+            # MUST open when every remaining layer is needed to fill the
+            # remaining stages; MAY open when the current stage hit the
+            # balance target and enough layers remain
+            if layers_left <= stages_to_open or (
+                    acc >= target and stages_to_open > 0
+                    and layers_left >= stages_to_open):
+                stages.append([])
+                acc = 0.0
         stages[-1].append(i)
         acc += c
-    while len(stages) < n_stages:  # degenerate tiny nets
-        stages.append([stages[-1].pop()] if len(stages[-1]) > 1 else [])
-    return [s for s in stages if s]
+    return stages
 
 
 class PipelineParallelTrainingMaster(TrainingMaster):
@@ -111,7 +115,7 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                             for ls in self.stage_layers[:-1]]
         self._last_stage = jax.jit(make_last_stage(self.stage_layers[-1]))
         self._reg_fns = [
-            jax.jit(jax.grad(lambda sp, ls=ls: sum(
+            jax.jit(jax.value_and_grad(lambda sp, ls=ls: sum(
                 layer.reg_score(sp.get(layer.name, {})) for layer in ls)))
             for ls in self.stage_layers
         ]
@@ -205,11 +209,13 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                 grads[s] = gp if grads[s] is None else jax.tree_util.tree_map(
                     jnp.add, grads[s], gp)
 
-        # regularization gradients + updater apply, per stage on-device
+        # regularization value+gradients + updater apply, per stage on-device
         it = jnp.asarray(float(net.iteration))
+        reg_total = 0.0
         for s in range(S):
-            g = jax.tree_util.tree_map(
-                jnp.add, grads[s], self._reg_fns[s](stage_params[s]))
+            reg_val, reg_grad = self._reg_fns[s](stage_params[s])
+            reg_total += float(reg_val)
+            g = jax.tree_util.tree_map(jnp.add, grads[s], reg_grad)
             updates, stage_upd[s] = upd.update(
                 self._upd_cfg, g, stage_upd[s], it, self._lr_overrides)
             stage_params[s] = {
@@ -217,4 +223,5 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                      if (u := updates.get(ln)) else stage_params[s][ln])
                 for ln in stage_params[s]
             }
-        return sum(jax.device_get(l) for l in losses) / M
+        # score matches serial _loss_fn: data loss + regularization penalty
+        return sum(jax.device_get(l) for l in losses) / M + reg_total

@@ -1,0 +1,100 @@
+"""Generates the committed serialization-regression corpus.
+
+Run once per format change:  python tests/make_regression_fixtures.py
+
+Mirrors the reference's ``RegressionTest050`` strategy
+(``deeplearning4j-core/.../regressiontest/RegressionTest050.java:33-124``):
+checkpoints produced by an earlier build are committed and every later
+build must keep loading them bit-for-bit — the backward-compat contract on
+the zip format (config.json + params + updater state).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+FIXTURES = Path(__file__).parent / "regression_fixtures"
+
+
+def make_mlp():
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(42)
+            .updater("adam", learning_rate=0.01).list()
+            .layer(DenseLayer(n_in=6, n_out=10, activation="tanh",
+                              weight_init="xavier", l2=1e-4))
+            .layer(OutputLayer(n_in=10, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_cnn():
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+    )
+
+    conf = (NeuralNetConfiguration.builder().seed(42)
+            .updater("nesterovs", learning_rate=0.02).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2, loss="mcxent", activation="softmax"))
+            .set_input_type(InputType.convolutional_flat(8, 8, 1))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def make_lstm():
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(42)
+            .updater("rmsprop", learning_rate=0.01).list()
+            .layer(GravesLSTM(n_in=5, n_out=8, activation="tanh"))
+            .layer(RnnOutputLayer(n_in=8, n_out=4, loss="mcxent",
+                                  activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def main():
+    from deeplearning4j_tpu.models.serialization import write_model
+
+    FIXTURES.mkdir(exist_ok=True)
+    rs = np.random.RandomState(7)
+    cases = {
+        "mlp": (make_mlp(), rs.rand(4, 6).astype(np.float32),
+                np.eye(3, dtype=np.float32)[rs.randint(0, 3, 4)]),
+        "cnn": (make_cnn(), rs.rand(4, 64).astype(np.float32),
+                np.eye(2, dtype=np.float32)[rs.randint(0, 2, 4)]),
+        "lstm": (make_lstm(), rs.rand(2, 6, 5).astype(np.float32),
+                 np.eye(4, dtype=np.float32)[rs.randint(0, 4, (2, 6))]),
+    }
+    meta = {}
+    for name, (net, x, y) in cases.items():
+        for _ in range(3):  # non-trivial updater state
+            net.fit(x, y)
+        write_model(net, FIXTURES / f"{name}.zip")
+        out = np.asarray(net.output(x))
+        np.save(FIXTURES / f"{name}_input.npy", x)
+        np.save(FIXTURES / f"{name}_expected.npy", out)
+        meta[name] = {"score": float(net.score_value),
+                      "iterations": net.iteration}
+    (FIXTURES / "meta.json").write_text(json.dumps(meta, indent=2))
+    print("fixtures written to", FIXTURES)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""Text annotation (UIMA add-on analog), stopwords, moving windows,
+YAML config round-trip, profiler listener."""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    TextAnnotator, Window, get_stop_words, is_stop_word, pos_tag,
+    remove_stop_words, sentiment_score, split_sentences, windows,
+)
+
+
+def test_sentence_splitting():
+    text = "Dr. Smith went home. He was tired! Was it late? Yes."
+    sents = split_sentences(text)
+    assert sents == ["Dr. Smith went home.", "He was tired!", "Was it late?",
+                     "Yes."]
+
+
+def test_sentence_splitting_no_terminal():
+    assert split_sentences("no punctuation here") == ["no punctuation here"]
+
+
+def test_sentence_splitting_dotted_abbreviations():
+    # regression: 'e.g.'/'i.e.' must not end a sentence
+    assert split_sentences("See e.g. the docs.") == ["See e.g. the docs."]
+    assert split_sentences("It works, i.e. it compiles.") == [
+        "It works, i.e. it compiles."]
+
+
+def test_single_stoplist():
+    from deeplearning4j_tpu.nlp.stopwords import ENGLISH
+    from deeplearning4j_tpu.nlp.tokenization import STOP_WORDS
+
+    assert STOP_WORDS is ENGLISH
+
+
+def test_pos_tagging():
+    tags = dict(pos_tag(["the", "dog", "quickly", "jumped", "over", "3",
+                         "wonderful", "fences", "!"]))
+    assert tags["the"] == "DET"
+    assert tags["dog"] == "NOUN"
+    assert tags["quickly"] == "ADV"
+    assert tags["jumped"] == "VERB"
+    assert tags["over"] == "ADP"
+    assert tags["3"] == "NUM"
+    assert tags["wonderful"] == "ADJ"
+    assert tags["!"] == "PUNCT"
+
+
+def test_sentiment():
+    assert sentiment_score("this movie was great".split()) > 0.5
+    assert sentiment_score("this movie was terrible".split()) < -0.5
+    # negation flips within the window
+    assert sentiment_score("this was not good".split()) < 0
+    assert sentiment_score("nothing emotive here".split()) == 0.0
+
+
+def test_text_annotator_pipeline():
+    ann = TextAnnotator()
+    sents = ann.annotate("The food was great. The service was terrible.")
+    assert len(sents) == 2
+    assert sents[0].sentiment > 0 > sents[1].sentiment
+    assert any(t.pos == "ADJ" for t in sents[1].tokens)  # "terrible"
+
+
+def test_stop_words():
+    assert is_stop_word("The") and not is_stop_word("tensor")
+    assert "the" in get_stop_words()
+    assert remove_stop_words(["the", "quick", "fox"]) == ["quick", "fox"]
+
+
+def test_moving_windows():
+    ws = windows(["a", "b", "c", "d"], window_size=3)
+    assert len(ws) == 4
+    assert ws[0].as_list() == ["<s>", "a", "b"] and ws[0].focus_word == "a"
+    assert ws[3].as_list() == ["c", "d", "</s>"]
+    assert all(len(w.words) == 3 for w in ws)
+    with pytest.raises(ValueError):
+        windows(["a"], 0)
+
+
+def test_mcxent_sigmoid_warns():
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    with pytest.warns(UserWarning, match="mcxent.*sigmoid"):
+        (NeuralNetConfiguration.builder().list()
+         .layer(DenseLayer(n_in=4, n_out=8))
+         .layer(OutputLayer(n_in=8, n_out=2))  # defaults: sigmoid + mcxent
+         .build())
+
+
+def test_yaml_config_roundtrip():
+    from deeplearning4j_tpu.nn.conf import (
+        MultiLayerConfiguration, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(9)
+            .updater("adam", learning_rate=0.02).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu", l2=1e-4))
+            .layer(OutputLayer(n_in=8, n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    back = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    assert back.to_json() == conf.to_json()
+
+
+def test_yaml_graph_roundtrip():
+    from deeplearning4j_tpu.models.graph import (
+        ComputationGraph, GraphConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(3).graph()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2), "d")
+            .set_outputs("out")
+            .build())
+    back = GraphConfiguration.from_yaml(conf.to_yaml())
+    assert back.to_json() == conf.to_json()
+
+
+def test_profiler_listener(tmp_path):
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optimize.listeners import ProfilerListener
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.1).list()
+            .layer(DenseLayer(n_in=4, n_out=8))
+            .layer(OutputLayer(n_in=8, n_out=2)).build())
+    net = MultiLayerNetwork(conf).init()
+    prof = ProfilerListener(str(tmp_path), start_iteration=1, duration=2)
+    net.set_listeners(prof)
+    x = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+    for _ in range(5):
+        net.fit(x, y)
+    prof.stop()
+    produced = list(Path(tmp_path).rglob("*"))
+    assert any(p.is_file() for p in produced), "no trace files captured"

@@ -195,6 +195,45 @@ def test_moe_width_inference_from_input_type():
     assert np.isfinite(net.score_value)
 
 
+def test_split_stages_exact_stage_count():
+    # regression: 4 layers / 4 stages must give 4 singleton stages
+    net = mlp(widths=(8, 16, 16, 4))  # 3 layers
+    assert split_stages(net, 3) == [[0], [1], [2]]
+    net4 = mlp(widths=(8, 16, 16, 16, 4))  # 4 layers
+    assert split_stages(net4, 4) == [[0], [1], [2], [3]]
+
+
+def test_pipeline_score_includes_regularization():
+    def build():
+        return MultiLayerNetwork(
+            (NeuralNetConfiguration.builder().seed(4)
+             .updater("sgd", learning_rate=0.1).list()
+             .layer(DenseLayer(n_in=8, n_out=16, l2=0.01))
+             .layer(OutputLayer(n_in=16, n_out=4, l2=0.01)).build())).init()
+
+    x, y = data(16, 8, 4)
+    serial = build()
+    serial.fit(x, y)
+    pp_net = build()
+    DistributedNetwork(pp_net, PipelineParallelTrainingMaster(
+        n_stages=2, n_microbatches=2, devices=jax.devices()[:2])
+    ).fit(ListDataSetIterator(DataSet(x, y), 16))
+    assert abs(serial.score_value - pp_net.score_value) < 1e-5
+
+
+def test_moe_partial_inference_builds():
+    # regression: validate used to run before setup and reject inferred sizes
+    from deeplearning4j_tpu.nn.inputs import InputType
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+            .layer(MoELayer(num_experts=2, capacity_factor=2.0))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(6))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert net.params["layer_0"]["W_router"].shape == (6, 2)
+
+
 def test_moe_validation():
     with pytest.raises(ValueError, match="n_in == n_out"):
         (NeuralNetConfiguration.builder().list()
