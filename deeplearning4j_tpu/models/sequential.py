@@ -107,6 +107,20 @@ class MultiLayerNetwork:
         new_state = dict(net_state)
         new_carries = {}
         h = x
+        cd = self.conf.compute_dtype
+        if cd is not None:
+            # mixed precision: cast float leaves to the compute dtype; the
+            # cast sits inside the graph, so grads flow back to fp32 params
+            # (loss and updater math stay fp32)
+            dt = jnp.dtype(cd)
+
+            def _cast(a):
+                return (a.astype(dt)
+                        if hasattr(a, "dtype")
+                        and jnp.issubdtype(a.dtype, jnp.floating) else a)
+
+            params = jax.tree_util.tree_map(_cast, params)
+            h = _cast(jnp.asarray(h))
         n = len(self.layers)
         rngs = jax.random.split(rng, n) if rng is not None else [None] * n
         for i, layer in enumerate(self.layers):
@@ -153,6 +167,8 @@ class MultiLayerNetwork:
         pre, _, new_state, new_carries = self._forward(
             params, net_state, x, train=train, rng=rng, fmask=fmask, carries=carries
         )
+        if self.conf.compute_dtype is not None:
+            pre = pre.astype(jnp.float32)  # loss in full precision
         data_loss = losses_mod.score(out_layer.loss, y, pre, out_layer.activation, lmask)
         reg = jnp.zeros(())
         for layer in self.layers:
@@ -288,6 +304,8 @@ class MultiLayerNetwork:
             def out(params, net_state, x, fmask):
                 pre, _, _, _ = self._forward(params, net_state, x, train=False,
                                              rng=None, fmask=fmask)
+                if self.conf.compute_dtype is not None:
+                    pre = pre.astype(jnp.float32)  # fp32 API boundary
                 from deeplearning4j_tpu.nn import activations
 
                 return activations.get(self.layers[-1].activation)(pre)
@@ -306,6 +324,8 @@ class MultiLayerNetwork:
         pre, acts, _, _ = self._forward(self.params, self.net_state,
                                         jnp.asarray(x), train=train, rng=rng,
                                         collect=True)
+        if self.conf.compute_dtype is not None:
+            acts = [a.astype(jnp.float32) for a in acts]  # fp32 API boundary
         return acts
 
     def score(self, x=None, y=None, dataset=None, fmask=None, lmask=None) -> float:

@@ -21,9 +21,11 @@ def _norm(token: str) -> str:
 
 # --------------------------------------------------------------- sentences
 
+# NB deliberately excludes "no": sentence-final "no." (the word) is far more
+# common than the numeric abbreviation "No. 5"
 _ABBREV = frozenset([
     "mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs", "etc", "eg",
-    "ie", "inc", "ltd", "co", "corp", "no", "vol", "fig", "al",
+    "ie", "inc", "ltd", "co", "corp", "vol", "fig", "al",
 ])
 
 _SENT_BOUNDARY = re.compile(r"([.!?]+)(\s+|$)")

@@ -84,6 +84,10 @@ class MultiLayerConfiguration:
     tbptt_back_length: int = 20
     pretrain: bool = False
     backprop: bool = True
+    # mixed precision: forward/backward compute dtype ("bfloat16"); params,
+    # loss and updater math stay float32 (MXU-native policy; no reference
+    # analog — ND4J is float-global)
+    compute_dtype: Optional[str] = None
 
     # ---- serde ----------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
@@ -101,6 +105,7 @@ class MultiLayerConfiguration:
             "tbptt_back_length": self.tbptt_back_length,
             "pretrain": self.pretrain,
             "backprop": self.backprop,
+            "compute_dtype": self.compute_dtype,
         }
 
     def to_json(self) -> str:
@@ -121,6 +126,7 @@ class MultiLayerConfiguration:
             tbptt_back_length=d["tbptt_back_length"],
             pretrain=d.get("pretrain", False),
             backprop=d.get("backprop", True),
+            compute_dtype=d.get("compute_dtype"),
         )
 
     @staticmethod
@@ -153,6 +159,15 @@ class ListBuilder:
         self._tbptt_back = 20
         self._pretrain = False
         self._backprop = True
+        self._compute_dtype: Optional[str] = None
+
+    def compute_dtype(self, dtype: str) -> "ListBuilder":
+        """Mixed precision: run forward/backward in `dtype` ("bfloat16");
+        params, loss and the updater stay float32."""
+        if dtype not in ("bfloat16", "float16", "float32"):
+            raise ValueError(f"unsupported compute dtype '{dtype}'")
+        self._compute_dtype = None if dtype == "float32" else dtype
+        return self
 
     def layer(self, layer: Layer, index: Optional[int] = None) -> "ListBuilder":
         if index is not None and index != len(self._layers):
@@ -224,6 +239,7 @@ class ListBuilder:
             tbptt_back_length=self._tbptt_back,
             pretrain=self._pretrain,
             backprop=self._backprop,
+            compute_dtype=self._compute_dtype,
         )
 
 

@@ -63,6 +63,10 @@ class OutputLayer(DenseLayer):
     ``loss`` names a function in :mod:`deeplearning4j_tpu.nn.losses`."""
 
     loss: str = "mcxent"
+    # default differs from the base "sigmoid": with the default mcxent loss
+    # sigmoid degenerates (see validate); softmax is the classification
+    # default users expect
+    activation: str = "softmax"
 
     def validate(self) -> None:
         super().validate()

@@ -211,10 +211,10 @@ class PipelineParallelTrainingMaster(TrainingMaster):
 
         # regularization value+gradients + updater apply, per stage on-device
         it = jnp.asarray(float(net.iteration))
-        reg_total = 0.0
+        reg_vals = []
         for s in range(S):
             reg_val, reg_grad = self._reg_fns[s](stage_params[s])
-            reg_total += float(reg_val)
+            reg_vals.append(reg_val)  # no host sync inside the dispatch loop
             g = jax.tree_util.tree_map(jnp.add, grads[s], reg_grad)
             updates, stage_upd[s] = upd.update(
                 self._upd_cfg, g, stage_upd[s], it, self._lr_overrides)
@@ -224,4 +224,5 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                 for ln in stage_params[s]
             }
         # score matches serial _loss_fn: data loss + regularization penalty
-        return sum(jax.device_get(l) for l in losses) / M + reg_total
+        return (sum(jax.device_get(l) for l in losses) / M
+                + sum(float(r) for r in reg_vals))

@@ -2,3 +2,4 @@ from deeplearning4j_tpu.utils.interop import (
     to_torch, from_torch, dataset_to_torch, dataset_from_torch,
     labeled_points_to_dataset, dataset_to_labeled_points,
 )
+from deeplearning4j_tpu.utils.viterbi import Viterbi, viterbi_decode

@@ -1,0 +1,78 @@
+"""Viterbi decoding — most-likely state sequence under a transition model.
+
+Reference: ``deeplearning4j-nn/.../util/Viterbi.java`` (decodes binarized
+label sequences given emission probabilities and a transition weight).
+TPU-native: the forward max-product recursion is a ``lax.scan`` over time
+(static shapes, no Python loop), backtrace on host.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def viterbi_decode(emission_logprobs, transition_logprobs
+                   ) -> Tuple[np.ndarray, float]:
+    """Most likely state path.
+
+    emission_logprobs  [T, S] — per-timestep state log-scores
+    transition_logprobs [S, S] — log P(next=j | prev=i)
+
+    Returns (path [T] int array, path log-score).
+    """
+    em = jnp.asarray(emission_logprobs, jnp.float32)
+    tr = jnp.asarray(transition_logprobs, jnp.float32)
+    T, S = em.shape
+    if tr.shape != (S, S):
+        raise ValueError(f"transition matrix {tr.shape} != ({S},{S})")
+
+    def step(delta, em_t):
+        # delta [S]: best score ending in each state at t-1
+        scores = delta[:, None] + tr           # [S_prev, S_next]
+        best_prev = jnp.argmax(scores, axis=0)  # [S]
+        new_delta = jnp.max(scores, axis=0) + em_t
+        return new_delta, best_prev
+
+    delta0 = em[0]
+    final_delta, backptrs = jax.lax.scan(step, delta0, em[1:])
+    backptrs = np.asarray(backptrs)            # [T-1, S]
+    path = np.empty(T, np.int64)
+    path[-1] = int(jnp.argmax(final_delta))
+    for t in range(T - 2, -1, -1):
+        path[t] = backptrs[t, path[t + 1]]
+    return path, float(jnp.max(final_delta))
+
+
+class Viterbi:
+    """Reference-shaped facade (``util/Viterbi.java``): binary label
+    smoothing with a possibility-of-transition prior."""
+
+    def __init__(self, possible_labels, meta_stability: float = 0.9,
+                 p_correct: float = 0.99):
+        self.labels = np.asarray(possible_labels)
+        if len(self.labels) < 2:
+            raise ValueError("need >= 2 possible labels")
+        self.meta_stability = meta_stability
+        self.p_correct = p_correct
+
+    def decode(self, observed_labels) -> Tuple[np.ndarray, float]:
+        """Smooth an observed label sequence: each observation emits its
+        label with p_correct; transitions prefer staying (meta_stability)."""
+        obs = np.asarray(observed_labels)
+        S = len(self.labels)
+        label_to_idx = {l: i for i, l in enumerate(self.labels.tolist())}
+        idx = np.array([label_to_idx[l] for l in obs.tolist()])
+        T = len(idx)
+        eps = 1e-6
+        em = np.full((T, S), np.log((1 - self.p_correct) / max(S - 1, 1) + eps),
+                     np.float32)
+        em[np.arange(T), idx] = np.log(self.p_correct)
+        tr = np.full((S, S), np.log((1 - self.meta_stability) / max(S - 1, 1)
+                                    + eps), np.float32)
+        np.fill_diagonal(tr, np.log(self.meta_stability))
+        path, score = viterbi_decode(em, tr)
+        return self.labels[path], score

@@ -89,8 +89,10 @@ def test_mcxent_sigmoid_warns():
     with pytest.warns(UserWarning, match="mcxent.*sigmoid"):
         (NeuralNetConfiguration.builder().list()
          .layer(DenseLayer(n_in=4, n_out=8))
-         .layer(OutputLayer(n_in=8, n_out=2))  # defaults: sigmoid + mcxent
+         .layer(OutputLayer(n_in=8, n_out=2, activation="sigmoid"))
          .build())
+    # the defaults themselves are safe now (softmax + mcxent)
+    assert OutputLayer(n_in=8, n_out=2).activation == "softmax"
 
 
 def test_yaml_config_roundtrip():

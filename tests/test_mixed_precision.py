@@ -1,0 +1,82 @@
+"""Mixed precision (bf16 compute / fp32 params) + Viterbi decoding."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.utils import Viterbi, viterbi_decode
+
+
+def build(compute_dtype=None, seed=5):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("adam", learning_rate=0.05).list()
+         .layer(DenseLayer(n_in=4, n_out=32, activation="relu"))
+         .layer(OutputLayer(n_in=32, n_out=2, loss="mcxent",
+                            activation="softmax")))
+    if compute_dtype:
+        b = b.compute_dtype(compute_dtype)
+    return MultiLayerNetwork(b.build()).init()
+
+
+def task_data(n=64):
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 2).astype(int)]
+    return x, y
+
+
+def test_bf16_trains_params_stay_fp32():
+    net = build("bfloat16")
+    x, y = task_data()
+    for _ in range(60):
+        net.fit(x, y)
+    # master params remain fp32
+    assert net.params["layer_0"]["W"].dtype == jnp.float32
+    acc = (np.asarray(net.output(x)).argmax(-1) == y.argmax(-1)).mean()
+    assert acc > 0.9, acc
+    assert np.isfinite(net.score_value)
+
+
+def test_bf16_close_to_fp32():
+    x, y = task_data()
+    a, b = build("bfloat16"), build(None)
+    for _ in range(20):
+        a.fit(x, y)
+        b.fit(x, y)
+    # same seed, same data: scores track within bf16 noise
+    assert abs(a.score_value - b.score_value) < 0.05
+
+
+def test_compute_dtype_serializes():
+    conf = build("bfloat16").conf
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.compute_dtype == "bfloat16"
+
+
+def test_compute_dtype_validation():
+    with pytest.raises(ValueError, match="unsupported"):
+        NeuralNetConfiguration.builder().list().compute_dtype("int8")
+
+
+def test_viterbi_decode_prefers_transitions():
+    # emissions say state 1 at t=1 only weakly; strong self-transitions
+    # keep the path in state 0
+    em = np.log(np.array([[0.9, 0.1], [0.45, 0.55], [0.9, 0.1]], np.float32))
+    tr = np.log(np.array([[0.95, 0.05], [0.05, 0.95]], np.float32))
+    path, score = viterbi_decode(em, tr)
+    assert path.tolist() == [0, 0, 0]
+    assert np.isfinite(score)
+
+
+def test_viterbi_facade_smooths_flicker():
+    v = Viterbi([0, 1], meta_stability=0.95, p_correct=0.9)
+    smoothed, _ = v.decode([0, 0, 1, 0, 0, 0])
+    assert smoothed.tolist() == [0, 0, 0, 0, 0, 0]
+    # a sustained switch survives smoothing
+    smoothed2, _ = v.decode([0, 0, 1, 1, 1, 1])
+    assert smoothed2.tolist()[-3:] == [1, 1, 1]
