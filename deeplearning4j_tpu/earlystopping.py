@@ -332,6 +332,23 @@ class EarlyStoppingTrainer:
         self.train = train_iterator
         self.listener = listener
 
+    def _train_epoch(self, cfg):
+        """One pass over the training iterator.  Returns (terminate, reason)
+        from the iteration termination conditions."""
+        for ds in self.train:
+            if hasattr(ds, "features"):
+                self.net.fit(ds.features, ds.labels,
+                             fmask=getattr(ds, "features_mask", None),
+                             lmask=getattr(ds, "labels_mask", None))
+            else:
+                x, y = ds[0], ds[1]
+                self.net.fit(x, y)
+            last_score = self.net.score_value
+            for c in cfg.iteration_termination_conditions:
+                if c.terminate(last_score):
+                    return True, c
+        return False, None
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.iteration_termination_conditions:
@@ -350,21 +367,7 @@ class EarlyStoppingTrainer:
             terminate = False
             reason: Optional[IterationTerminationCondition] = None
             try:
-                for ds in self.train:
-                    if hasattr(ds, "features"):
-                        self.net.fit(ds.features, ds.labels,
-                                     fmask=getattr(ds, "features_mask", None),
-                                     lmask=getattr(ds, "labels_mask", None))
-                    else:
-                        x, y = ds[0], ds[1]
-                        self.net.fit(x, y)
-                    last_score = self.net.score_value
-                    for c in cfg.iteration_termination_conditions:
-                        if c.terminate(last_score):
-                            terminate, reason = True, c
-                            break
-                    if terminate:
-                        break
+                terminate, reason = self._train_epoch(cfg)
             except Exception as e:  # ≙ reference Error termination path
                 result = EarlyStoppingResult(
                     TerminationReason.ERROR, repr(e), score_vs_epoch,
@@ -427,3 +430,26 @@ class EarlyStoppingListener:
 
     def on_completion(self, result) -> None:  # pragma: no cover - hook
         pass
+
+
+class DistributedEarlyStoppingTrainer(EarlyStoppingTrainer):
+    """Early stopping over mesh-distributed training.
+
+    ≙ ``spark/dl4j-spark/.../earlystopping/BaseSparkEarlyStoppingTrainer.java``
+    (fit an epoch through the Spark wrapper, score, check conditions) — here
+    each epoch trains through the DistributedNetwork's TrainingMaster and the
+    iteration conditions see the post-epoch score.
+    """
+
+    def __init__(self, config: EarlyStoppingConfiguration, dist_net,
+                 train_iterator, listener: Optional[Any] = None):
+        super().__init__(config, dist_net.net, train_iterator, listener)
+        self.dist = dist_net
+
+    def _train_epoch(self, cfg):
+        self.dist.fit(self.train)
+        last_score = self.net.score_value
+        for c in cfg.iteration_termination_conditions:
+            if c.terminate(last_score):
+                return True, c
+        return False, None

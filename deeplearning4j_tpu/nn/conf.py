@@ -89,6 +89,14 @@ class MultiLayerConfiguration:
     # analog — ND4J is float-global)
     compute_dtype: Optional[str] = None
 
+    def __post_init__(self):
+        # guard every construction path (builder, from_dict, direct): an
+        # unknown compute dtype would silently cast params to garbage
+        if self.compute_dtype not in (None, "bfloat16", "float16"):
+            raise ValueError(
+                f"unsupported compute_dtype '{self.compute_dtype}' "
+                "(use 'bfloat16', 'float16', or None)")
+
     # ---- serde ----------------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         return {

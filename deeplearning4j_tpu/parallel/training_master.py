@@ -225,12 +225,42 @@ class DistributedNetwork:
         return self.net
 
     def evaluate(self, iterator, evaluation=None):
+        """Evaluation with the forward pass sharded over the master's mesh
+        (≙ Spark evaluation as mapPartitions + tree-aggregated counts: each
+        device scores its batch shard, metrics accumulate on host)."""
         from deeplearning4j_tpu.evaluation import Evaluation
 
         ev = evaluation or Evaluation()
+        mesh = getattr(self.master, "mesh", None)
+        out_fn = self.net.output
+        pad_to = 1
+        # sharded fast path needs the net's cached jittable forward
+        # (MultiLayerNetwork); ComputationGraph falls back to net.output
+        if (mesh is not None and backend.AXIS_DATA in mesh.shape
+                and hasattr(self.net, "_output_fn")):
+            pad_to = mesh.shape[backend.AXIS_DATA]
+            if getattr(self, "_eval_mesh", None) is not mesh:
+                data = NamedSharding(mesh, P(backend.AXIS_DATA))
+                repl = NamedSharding(mesh, P())
+                self._eval_fn = jax.jit(self.net._output_fn(),
+                                        in_shardings=(repl, repl, data, data))
+                self._eval_mesh = mesh
+            sharded = self._eval_fn
+
+            def out_fn(x, fmask=None):  # noqa: E306
+                return sharded(self.net.params, self.net.net_state,
+                               jnp.asarray(x),
+                               None if fmask is None else jnp.asarray(fmask))
+
         for ds in iterator:
-            out = self.net.output(ds.features, fmask=ds.features_mask)
-            ev.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+            n = len(ds)
+            if n % pad_to:
+                ds_run = ds.pad_batch(((n + pad_to - 1) // pad_to) * pad_to)
+            else:
+                ds_run = ds
+            out = np.asarray(out_fn(ds_run.features,
+                                    fmask=ds_run.features_mask))[:n]
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
         return ev
 
     def score(self, dataset):

@@ -13,6 +13,9 @@ from deeplearning4j_tpu.ui.components import (
     StyleChart,
     component_from_dict,
 )
+from deeplearning4j_tpu.ui.render import (
+    ConvolutionalIterationListener, activation_grid, write_png,
+)
 from deeplearning4j_tpu.ui.server import RemoteStatsListener, UIServer
 from deeplearning4j_tpu.ui.stats import (
     FlowIterationListener,

@@ -1,0 +1,144 @@
+"""Distributed early stopping, sharded evaluation, conv-activation rendering."""
+
+import numpy as np
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, DistributedEarlyStoppingTrainer,
+    EarlyStoppingConfiguration, InMemoryModelSaver,
+    MaxEpochsTerminationCondition, TerminationReason,
+)
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer,
+)
+from deeplearning4j_tpu.parallel import DistributedNetwork, SyncTrainingMaster
+from deeplearning4j_tpu.ui import (
+    ConvolutionalIterationListener, activation_grid, write_png,
+)
+
+
+def small_net():
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("adam", learning_rate=0.05).list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=2)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def task(n=64):
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 2).astype(int)]
+    return DataSet(x, y)
+
+
+def test_distributed_early_stopping():
+    train = ListDataSetIterator(task(64), 16)
+    val = ListDataSetIterator(task(32), 16)
+    dist = DistributedNetwork(small_net(),
+                              SyncTrainingMaster(mesh=backend.default_mesh()))
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+           .score_calculator(DataSetLossCalculator(val))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    result = DistributedEarlyStoppingTrainer(cfg, dist, train).fit()
+    assert result.termination_reason == TerminationReason.EPOCH_TERMINATION_CONDITION
+    assert result.total_epochs == 3
+    assert result.best_model is not None
+
+
+def test_sharded_evaluation_matches_serial():
+    ds = task(50)  # deliberately not divisible by 8
+    net = small_net()
+    net.fit(ds.features, ds.labels)
+    dist = DistributedNetwork(net, SyncTrainingMaster(mesh=backend.default_mesh()))
+    ev_sharded = dist.evaluate(ListDataSetIterator(ds, 25, drop_last=True))
+    # serial oracle
+    from deeplearning4j_tpu.evaluation import Evaluation
+
+    ev = Evaluation()
+    for b in ListDataSetIterator(ds, 25, drop_last=True):
+        ev.eval(b.labels, np.asarray(net.output(b.features)))
+    assert ev_sharded.accuracy() == ev.accuracy()
+
+
+def test_graph_net_evaluate_falls_back_to_serial():
+    # ComputationGraph has no _output_fn; evaluate must not crash
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.1).graph()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=4, n_out=8), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=2), "d")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    dist = DistributedNetwork(net, SyncTrainingMaster(mesh=backend.default_mesh()))
+    ds = task(16)
+    ev = dist.evaluate(ListDataSetIterator(ds, 8))
+    assert 0.0 <= ev.accuracy() <= 1.0
+
+
+def test_compute_dtype_rejected_from_json():
+    import pytest
+
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    conf = (NeuralNetConfiguration.builder().list()
+            .layer(DenseLayer(n_in=2, n_out=2))
+            .layer(OutputLayer(n_in=2, n_out=2)).build())
+    d = conf.to_dict()
+    d["compute_dtype"] = "int8"
+    with pytest.raises(ValueError, match="compute_dtype"):
+        MultiLayerConfiguration.from_dict(d)
+
+
+def test_conv_listener_skips_non_conv_layer_index(tmp_path):
+    net = small_net()
+    listener = ConvolutionalIterationListener(
+        np.zeros((1, 4), np.float32), tmp_path, frequency=1, layer_index=0)
+    net.set_listeners(listener)
+    ds = task(8)
+    net.fit(ds.features, ds.labels)  # dense activation: skipped, no crash
+    assert listener.rendered == []
+
+
+def test_activation_grid_channels_first():
+    a = np.random.RandomState(0).rand(5, 6, 6).astype(np.float32)  # [C,H,W]
+    grid = activation_grid(a, channels_last=False)
+    assert grid.shape == (2 * 7 - 1, 3 * 7 - 1)
+
+
+def test_activation_grid_and_png(tmp_path):
+    a = np.random.RandomState(0).rand(6, 6, 5).astype(np.float32)
+    grid = activation_grid(a)
+    assert grid.shape == (2 * 7 - 1, 3 * 7 - 1)  # 5 channels -> 2x3 grid
+    p = tmp_path / "g.png"
+    write_png(p, grid)
+    data = p.read_bytes()
+    assert data[:8] == b"\x89PNG\r\n\x1a\n" and b"IEND" in data
+
+
+def test_convolutional_listener_renders(tmp_path):
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.05).list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional_flat(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    x = rs.rand(8, 64).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 8)]
+    listener = ConvolutionalIterationListener(x, tmp_path, frequency=1)
+    net.set_listeners(listener)
+    net.fit(x, y)
+    assert listener.rendered and listener.rendered[0].exists()
