@@ -42,6 +42,7 @@ from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory, TokenizerFactory,
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 
 
@@ -226,4 +227,61 @@ class DistributedWord2Vec(Word2Vec):
             w2v = super().build()
             return DistributedWord2Vec(
                 w2v.config, w2v.sentence_iterator, w2v.tokenizer_factory,
+                mesh=self._mesh)
+
+
+class DistributedGlove(Glove):
+    """GloVe whose weighted-least-squares batches shard over the mesh.
+
+    ≙ ``spark/dl4j-spark-nlp/.../glove/Glove.java`` (partition-parallel
+    training with per-partition averaging).  Co-occurrence triples shard
+    over the data axis; each shard runs the AdaGrad kernel on its slice and
+    the parameter/accumulator deltas are pmean-ed — the reference's
+    partition-averaged semantics per batch (AdaGrad's nonlinearity makes
+    exact serial equivalence impossible here, as it was for Spark)."""
+
+    def __init__(self, *args, mesh: Optional[Mesh] = None, **kw):
+        super().__init__(*args, **kw)
+        self.mesh = mesh or backend.default_mesh()
+        axis = self.mesh.axis_names[0]
+        ndev = self.mesh.shape[axis]
+        if self.batch_size % ndev:
+            self.batch_size = int(np.ceil(self.batch_size / ndev) * ndev)
+        mesh_ = self.mesh
+
+        @partial(shard_map, mesh=mesh_,
+                 in_specs=(P(),) * 8 + (P(axis),) * 4 + (P(),) * 3,
+                 out_specs=(P(),) * 9)
+        def stepped(w, wc, b, bc, hw, hwc, hb, hbc, rows, cols, xij, mask,
+                    lr, x_max, alpha):
+            outs = learning.glove_step(w, wc, b, bc, hw, hwc, hb, hbc,
+                                       rows, cols, xij, mask, lr, x_max,
+                                       alpha)
+            *new_state, loss = outs
+            old = (w, wc, b, bc, hw, hwc, hb, hbc)
+            averaged = tuple(
+                o + jax.lax.pmean(n - o, axis)
+                for o, n in zip(old, new_state))
+            return averaged + (jax.lax.psum(loss, axis),)
+
+        self._glove_step = jax.jit(stepped)
+
+    class Builder(Glove.Builder):
+        def __init__(self):
+            super().__init__()
+            self._mesh = None
+
+        def mesh(self, mesh: Mesh) -> "DistributedGlove.Builder":
+            self._mesh = mesh
+            return self
+
+        def build(self) -> "DistributedGlove":
+            g = super().build()
+            return DistributedGlove(
+                sentence_iterator=g.sentence_iterator,
+                tokenizer_factory=g.tokenizer_factory,
+                layer_size=g.layer_size, window=g.window, epochs=g.epochs,
+                learning_rate=g.learning_rate, x_max=g.x_max, alpha=g.alpha,
+                min_word_frequency=g.min_word_frequency,
+                batch_size=g.batch_size, seed=g.seed, symmetric=g.symmetric,
                 mesh=self._mesh)

@@ -104,6 +104,9 @@ class Glove(WordVectors):
                 if toks:
                     yield toks
 
+    # seam for the distributed variant (DistributedGlove shards this)
+    _glove_step = staticmethod(learning.glove_step)
+
     def fit(self) -> "Glove":
         # vocab
         def seqs():
@@ -142,7 +145,7 @@ class Glove(WordVectors):
                 r = np.concatenate([rows[sel], np.zeros(pad, np.int32)])
                 c = np.concatenate([cols[sel], np.zeros(pad, np.int32)])
                 x = np.concatenate([vals[sel], np.ones(pad, np.float32)])
-                (w, wc, b, bc, hw, hwc, hb, hbc, loss) = learning.glove_step(
+                (w, wc, b, bc, hw, hwc, hb, hbc, loss) = self._glove_step(
                     w, wc, b, bc, hw, hwc, hb, hbc,
                     jnp.asarray(r), jnp.asarray(c), jnp.asarray(x),
                     jnp.asarray(mask), jnp.float32(self.learning_rate),

@@ -76,6 +76,30 @@ def test_full_mesh_distributed_word2vec_learns_structure():
     assert len(set(near) & {"snow", "storm", "cloud", "wind", "sun"}) >= 3
 
 
+def test_distributed_glove_learns_structure():
+    from deeplearning4j_tpu.nlp.distributed import DistributedGlove
+
+    glove = (DistributedGlove.Builder()
+             .iterate(synthetic_corpus(400))
+             .layer_size(24)
+             .window_size(4)
+             .epochs(25)
+             .learning_rate(0.1)
+             .min_word_frequency(2)
+             .seed(3)
+             .mesh(backend.default_mesh())
+             .build())
+    glove.fit()
+    weather = ["rain", "snow", "storm"]
+    finance = ["bank", "money", "stock"]
+    within = np.mean([glove.similarity(a, b)
+                      for a in weather for b in weather if a != b])
+    across = np.mean([glove.similarity(a, b)
+                      for a in weather for b in finance])
+    assert within > across + 0.1, f"within={within:.3f} across={across:.3f}"
+    assert glove.batch_size % 8 == 0
+
+
 def test_distributed_negative_sampling_learns_structure():
     sentences = synthetic_corpus()
     model = (builder(DistributedWord2Vec, sentences)
