@@ -1,71 +1,331 @@
-"""Benchmark entry — LeNet-MNIST train-step time on the local accelerator.
+"""Benchmark entry — ResNet-50 images/sec/chip (headline, with MFU), plus
+LeNet-MNIST step time and GravesLSTM char-LM throughput.
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Prints ONE JSON line.  Top-level fields follow the driver schema
+(metric/value/unit/vs_baseline) for the headline metric; the ``all`` field
+carries every metric with FLOPs (XLA cost analysis of the compiled train
+step), MFU vs the chip's peak, and data provenance (``real`` | ``synthetic``).
 
-Baseline: the reference stack is DL4J/ND4J on CPU BLAS (it publishes no
-numbers — BASELINE.md); a reference-class CPU measurement (torch-CPU LeNet,
-batch 128, single-thread BLAS, measured in this image: 62.45 ms/step) stands
-in as the comparison point.  vs_baseline = baseline_ms / our_ms (>1 = faster
-than reference-class CPU).
+Baselines: the reference (DL4J 0.4 on CPU BLAS) publishes no numbers
+(BASELINE.md), so measured torch-CPU runs of the same configs stand in —
+reproduce them with ``python bench_baseline_cpu.py`` (writes
+``baseline_cpu.json``, which this script reads).  vs_baseline > 1 means
+faster than the reference-class CPU.
+
+Robustness: backend init is retried once; any failure prints a JSON error
+line (never a bare traceback) and exits 1.
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-REFERENCE_CPU_STEP_MS = 62.45  # torch-CPU LeNet b128 step, this image (see docstring)
-BATCH = 128
-WARMUP = 5
-ITERS = 50
+# measured in this image by bench_baseline_cpu.py; overridden by
+# baseline_cpu.json when present (keep in sync when re-measuring)
+FALLBACK_BASELINES = {
+    "lenet_step_ms": 62.45,
+    "resnet50_imgs_per_sec": None,
+    "lstm_chars_per_sec": None,
+}
+
+# peak dense matmul throughput per chip, bf16 FLOP/s (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v6": 918e12,
+    "TPU v5p": 459e12,
+    "TPU v5": 197e12,   # v5 lite (v5e)
+    "TPU v4": 275e12,
+    "TPU v3": 123e12,
+    "TPU v2": 46e12,
+}
+
+
+def _load_baselines():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline_cpu.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            d = json.load(f)
+        return {k: d.get(k, FALLBACK_BASELINES[k]) for k in FALLBACK_BASELINES}
+    return dict(FALLBACK_BASELINES)
+
+
+def _devices_with_retry():
+    import jax
+
+    last = None
+    for attempt in range(2):
+        try:
+            return jax.devices()
+        except Exception as e:  # backend init flake: retry once
+            last = e
+            time.sleep(5.0)
+    raise RuntimeError(f"jax backend init failed after retry: {last}")
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for prefix, peak in PEAK_FLOPS.items():
+        if kind.startswith(prefix):
+            return peak
+    return 0.0
+
+
+def _compile_step(jitted, *args):
+    """AOT-compile once; return (flops, compiled executable).  The timing
+    loops call the executable directly so the model is never compiled twice."""
+    compiled = jitted.lower(*args).compile()
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+    except Exception:
+        flops = 0.0
+    return flops, compiled
+
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted", "out of memory",
+                "OOM", "Out of memory")
+
+
+def _is_oom(e: Exception) -> bool:
+    return any(m in str(e) for m in _OOM_MARKERS)
+
+
+def _sync(out):
+    """Force completion by fetching the value to host.  On the tunneled TPU
+    platform ``jax.block_until_ready`` can return before remote execution
+    finishes (experimental 'axon' backend), which once produced a
+    faster-than-peak phantom reading; ``device_get`` cannot be elided."""
+    import jax
+
+    return np.asarray(jax.device_get(out))
+
+
+def _time_loop(run_one, warmup, iters, block):
+    """Steady-state per-step time: chain ``iters`` steps (each consuming the
+    previous step's outputs) and block once at the end — async dispatch hides
+    host/tunnel latency exactly as a real training loop does."""
+    out = None
+    for _ in range(warmup):
+        out = run_one()
+    block(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run_one()
+    block(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _time_loop_synced(run_one, iters, block):
+    """Hard-synced fallback: block after EVERY step (includes round-trip
+    latency; used only when chained timing is implausible)."""
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        block(run_one())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _checked_time(run_one, warmup, iters, block, flops, peak):
+    """Chained timing, re-measured hard-synced if the implied FLOP/s exceeds
+    the chip's peak (a physically impossible reading — seen when the device
+    tunnel misreports readiness)."""
+    dt = _time_loop(run_one, warmup, iters, block)
+    if flops and peak and flops / dt > peak:
+        dt = max(dt, _time_loop_synced(run_one, max(5, iters // 4), block))
+        return dt, "synced"
+    return dt, "chained"
+
+
+def bench_lenet(platform, baselines):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.mnist import MnistDataFetcher
+    from deeplearning4j_tpu.models.zoo import lenet
+
+    batch = 128
+    net = lenet(updater="nesterovs", lr=0.01)
+    fetcher = MnistDataFetcher(train=True, num_examples=batch * 4)
+    ds = fetcher.dataset()
+    xj = jnp.asarray(ds.features[:batch])
+    yj = jnp.asarray(ds.labels[:batch])
+    step = net._get_train_step()
+    state = [net.params, net.updater_state, net.net_state]
+    flops, compiled = _compile_step(step, state[0], state[1], state[2],
+                                    jnp.zeros(()), xj, yj, net._keys.next(),
+                                    None, None, None)
+
+    def one():
+        state[0], state[1], state[2], loss, _ = compiled(
+            state[0], state[1], state[2], jnp.zeros(()), xj, yj,
+            net._keys.next(), None, None, None)
+        return loss
+
+    warmup, iters = (5, 100) if platform == "tpu" else (2, 10)
+    peak = _peak_flops(jax.devices()[0])
+    dt, timing = _checked_time(one, warmup, iters, _sync, flops, peak)
+    base = baselines["lenet_step_ms"]
+    return {
+        "metric": "LeNet-MNIST train step time (batch 128)",
+        "value": round(dt * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(base / (dt * 1e3), 2) if base else None,
+        "data": "synthetic" if getattr(fetcher, "is_synthetic", True) else "real",
+        "dtype": "float32",
+        "flops_per_step": flops,
+        "imgs_per_sec": round(batch / dt, 1),
+        "timing": timing,
+    }
+
+
+def bench_resnet50(platform, baselines, peak):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import resnet50
+
+    batches = [128, 64, 32] if platform == "tpu" else [4]
+    last_err = None
+    for batch in batches:
+        try:
+            net = resnet50(compute_dtype="bfloat16")
+            rs = np.random.RandomState(0)
+            x = {"input": jnp.asarray(rs.rand(batch, 224, 224, 3).astype(np.float32))}
+            y = {"fc": jnp.asarray(
+                np.eye(1000, dtype=np.float32)[rs.randint(0, 1000, batch)])}
+            step = net._get_train_step()
+            state = [net.params, net.updater_state, net.net_state]
+            flops, compiled = _compile_step(step, state[0], state[1], state[2],
+                                            jnp.zeros(()), x, y,
+                                            net._keys.next(), None, None, None)
+
+            def one():
+                state[0], state[1], state[2], loss, _ = compiled(
+                    state[0], state[1], state[2], jnp.zeros(()), x, y,
+                    net._keys.next(), None, None, None)
+                return loss
+
+            warmup, iters = (3, 50) if platform == "tpu" else (1, 2)
+            dt, timing = _checked_time(one, warmup, iters, _sync,
+                                       flops, peak)
+            imgs = batch / dt
+            base = baselines["resnet50_imgs_per_sec"]
+            mfu = (flops / dt / peak) if (flops and peak) else None
+            return {
+                "metric": "ResNet-50 images/sec/chip (224x224, train, bf16)",
+                "value": round(imgs, 1),
+                "unit": "imgs/sec",
+                "vs_baseline": round(imgs / base, 2) if base else None,
+                "data": "synthetic",
+                "dtype": "bfloat16",
+                "batch": batch,
+                "flops_per_step": flops,
+                "step_ms": round(dt * 1e3, 2),
+                "mfu": round(mfu, 4) if mfu is not None else None,
+                "timing": timing,
+            }
+        except Exception as e:
+            if not _is_oom(e):
+                raise  # real bug: surface the first failure, don't mask it
+            last_err = e  # OOM at this batch: try the next one down
+    raise RuntimeError(f"resnet50 bench OOM at all batches {batches}: {last_err}")
+
+
+def bench_graves_lstm(platform, baselines, peak):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import graves_lstm_char_lm
+
+    batch, seq, vocab = (128, 50, 77) if platform == "tpu" else (16, 20, 77)
+    net = graves_lstm_char_lm(vocab_size=vocab, hidden=200, tbptt=seq)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[ids])
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
+    step = net._get_train_step()
+    state = [net.params, net.updater_state, net.net_state]
+    flops, compiled = _compile_step(step, state[0], state[1], state[2],
+                                    jnp.zeros(()), x, y, net._keys.next(),
+                                    None, None, None)
+
+    def one():
+        state[0], state[1], state[2], loss, _ = compiled(
+            state[0], state[1], state[2], jnp.zeros(()), x, y,
+            net._keys.next(), None, None, None)
+        return loss
+
+    warmup, iters = (3, 50) if platform == "tpu" else (1, 3)
+    dt, timing = _checked_time(one, warmup, iters, _sync, flops, peak)
+    chars = batch * seq / dt
+    base = baselines["lstm_chars_per_sec"]
+    mfu = (flops / dt / peak) if (flops and peak) else None
+    return {
+        "metric": "GravesLSTM char-LM throughput (2x200, vocab 77)",
+        "value": round(chars, 1),
+        "unit": "chars/sec",
+        "vs_baseline": round(chars / base, 2) if base else None,
+        "data": "synthetic",
+        "dtype": "float32",
+        "batch": batch,
+        "seq_len": seq,
+        "flops_per_step": flops,
+        "step_ms": round(dt * 1e3, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "timing": timing,
+    }
 
 
 def main():
-    import jax
+    baselines = _load_baselines()
+    devices = _devices_with_retry()
+    dev = devices[0]
+    platform = dev.platform
+    peak = _peak_flops(dev)
 
-    from deeplearning4j_tpu.models.zoo import lenet
-    from deeplearning4j_tpu.datasets.mnist import MnistDataFetcher
+    metrics = []
+    errors = []
+    for fn in (lambda: bench_resnet50(platform, baselines, peak),
+               lambda: bench_lenet(platform, baselines),
+               lambda: bench_graves_lstm(platform, baselines, peak)):
+        try:
+            metrics.append(fn())
+        except Exception as e:
+            errors.append(str(e)[:300])
+    if not metrics:
+        raise RuntimeError("; ".join(errors) or "no metric ran")
 
-    net = lenet(updater="nesterovs", lr=0.01)
-    fetcher = MnistDataFetcher(train=True, num_examples=BATCH * 4)
-    ds = fetcher.dataset()
-    x = ds.features[:BATCH]
-    y = ds.labels[:BATCH]
-
-    step = net._get_train_step()
-    import jax.numpy as jnp
-
-    params, upd_state, net_state = net.params, net.updater_state, net.net_state
-    xj, yj = jnp.asarray(x), jnp.asarray(y)
-
-    def one(it):
-        nonlocal params, upd_state, net_state
-        params, upd_state, net_state, loss, _ = step(
-            params, upd_state, net_state, jnp.asarray(float(it)), xj, yj,
-            net._keys.next(), None, None, None,
-        )
-        return loss
-
-    for i in range(WARMUP):
-        loss = one(i)
-    jax.block_until_ready(loss)
-
-    t0 = time.perf_counter()
-    for i in range(ITERS):
-        loss = one(WARMUP + i)
-    jax.block_until_ready(loss)
-    dt_ms = (time.perf_counter() - t0) / ITERS * 1e3
-
+    head = metrics[0]
     result = {
-        "metric": "LeNet-MNIST train step time (batch 128)",
-        "value": round(dt_ms, 3),
-        "unit": "ms",
-        "vs_baseline": round(REFERENCE_CPU_STEP_MS / dt_ms, 2),
+        "metric": head["metric"],
+        "value": head["value"],
+        "unit": head["unit"],
+        "vs_baseline": head["vs_baseline"],
+        "mfu": head.get("mfu"),
+        "platform": platform,
+        "device_kind": getattr(dev, "device_kind", "unknown"),
+        "peak_flops": peak or None,
+        "baseline_source": ("baseline_cpu.json (torch-CPU, reproduce with "
+                            "bench_baseline_cpu.py)"),
+        "all": metrics,
     }
+    if errors:
+        result["errors"] = errors
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:
+        print(json.dumps({
+            "metric": "bench error", "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0, "error": str(e)[:500],
+        }))
+        sys.exit(1)

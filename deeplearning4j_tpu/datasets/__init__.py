@@ -8,6 +8,13 @@ from deeplearning4j_tpu.datasets.iterator import (
     IteratorDataSetIterator,
     NativeBatchDataSetIterator,
 )
+from deeplearning4j_tpu.datasets.multidataset import (
+    MultiDataSet,
+    MultiDataSetIterator,
+    ListMultiDataSetIterator,
+    AsyncMultiDataSetIterator,
+    RecordReaderMultiDataSetIterator,
+)
 from deeplearning4j_tpu.datasets.mnist import MnistDataSetIterator
 from deeplearning4j_tpu.datasets.iris import IrisDataSetIterator
 from deeplearning4j_tpu.datasets.cifar import CifarDataSetIterator

@@ -287,11 +287,14 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def _start(self):
         self._queue = queue.Queue(maxsize=self.prefetch)
+        self._producer_error: Optional[BaseException] = None
 
         def run():
             try:
                 while self.underlying.has_next():
                     self._queue.put(self.underlying.next())
+            except BaseException as e:  # surface on the consumer side —
+                self._producer_error = e  # never silently truncate the epoch
             finally:
                 self._queue.put(_SENTINEL)
 
@@ -299,12 +302,21 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread.start()
         self._next_item = self._queue.get()
 
+    def _check_error(self):
+        if self._producer_error is not None:
+            err, self._producer_error = self._producer_error, None
+            raise RuntimeError("async prefetch producer failed") from err
+
     def has_next(self):
-        return self._next_item is not _SENTINEL
+        if self._next_item is _SENTINEL:
+            self._check_error()
+            return False
+        return True
 
     def next(self):
         item = self._next_item
         if item is _SENTINEL:
+            self._check_error()
             raise StopIteration
         self._next_item = self._queue.get()
         return item

@@ -1,0 +1,36 @@
+"""Shared facade plumbing for MultiLayerNetwork / ComputationGraph.
+
+``LazyScoreMixin`` removes the per-step host sync from every training hot
+loop (the reference's score update ``BaseOptimizer.java`` feeds listeners a
+host double every iteration; on TPU a per-step ``float(loss)`` blocks step
+N+1's dispatch behind step N's execution).  Training loops store the
+*on-device* loss scalar; the transfer happens only when somebody actually
+reads ``score_value`` — a listener, early stopping, a test — and the fetched
+float is cached until the next step overwrites it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class LazyScoreMixin:
+    """Lazy ``score_value``: assign device arrays freely, pay the
+    device->host sync only on read."""
+
+    _score: Any = None
+
+    @property
+    def score_value(self) -> float:
+        s = getattr(self, "_score", None)
+        if s is None:
+            return float("nan")
+        if not isinstance(s, float):
+            s = float(s)  # device -> host sync happens here, on demand
+            self._score = s
+        return s
+
+    @score_value.setter
+    def score_value(self, value) -> None:
+        # accepts a python float OR an on-device scalar (no sync either way)
+        self._score = value

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.backend.rng import KeyStream
+from deeplearning4j_tpu.models.common import LazyScoreMixin
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn.conf import UpdaterConfig
 from deeplearning4j_tpu.nn.inputs import InputType
@@ -72,6 +73,7 @@ class GraphConfiguration:
     tbptt_back_length: int = 20
     optimization_algo: str = "stochastic_gradient_descent"
     num_iterations: int = 1
+    compute_dtype: Optional[str] = None  # mixed precision, as MLN conf
 
     def topological_order(self) -> List[str]:
         """Kahn's algorithm over the DAG (reference
@@ -137,6 +139,7 @@ class GraphConfiguration:
                 "tbptt_back_length": self.tbptt_back_length,
                 "optimization_algo": self.optimization_algo,
                 "num_iterations": self.num_iterations,
+                "compute_dtype": self.compute_dtype,
             },
             indent=2,
         )
@@ -156,6 +159,7 @@ class GraphConfiguration:
             tbptt_back_length=d.get("tbptt_back_length", 20),
             optimization_algo=d.get("optimization_algo", "stochastic_gradient_descent"),
             num_iterations=d.get("num_iterations", 1),
+            compute_dtype=d.get("compute_dtype"),
         )
 
 
@@ -168,9 +172,32 @@ class GraphBuilder:
         self._outputs: List[str] = []
         self._nodes: List[GraphNode] = []
         self._input_types: Dict[str, InputType] = {}
+        self._compute_dtype: Optional[str] = None
+        self._backprop_type = "standard"
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
 
     def add_inputs(self, *names: str) -> "GraphBuilder":
         self._inputs.extend(names)
+        return self
+
+    def compute_dtype(self, dtype: str) -> "GraphBuilder":
+        """Mixed-precision compute policy: params/optimizer fp32, forward/
+        backward math in ``dtype`` (same policy as ListBuilder.compute_dtype)."""
+        if dtype not in ("float32", "bfloat16", "float16"):
+            raise ValueError(f"unsupported compute_dtype '{dtype}'")
+        self._compute_dtype = None if dtype == "float32" else dtype
+        return self
+
+    def backprop_type(self, kind: str, fwd_length: int = 20,
+                      back_length: int = 20) -> "GraphBuilder":
+        """``standard`` or ``truncated_bptt`` (reference GraphBuilder
+        ``backpropType``/``tBPTTLength``)."""
+        if kind not in ("standard", "truncated_bptt"):
+            raise ValueError(f"unknown backprop type '{kind}'")
+        self._backprop_type = kind
+        self._tbptt_fwd = fwd_length
+        self._tbptt_back = back_length
         return self
 
     def set_input_types(self, **types: InputType) -> "GraphBuilder":
@@ -200,6 +227,10 @@ class GraphBuilder:
             seed=p._seed,
             optimization_algo=p._optimization_algo,
             num_iterations=p._num_iterations,
+            compute_dtype=self._compute_dtype,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
         )
         conf.validate()
         # shape inference pass: complete layers with n_in from input types
@@ -241,7 +272,7 @@ def _infer_shapes(conf: GraphConfiguration, input_types: Dict[str, InputType], p
     )
 
 
-class ComputationGraph:
+class ComputationGraph(LazyScoreMixin):
     """DAG-network facade mirroring MultiLayerNetwork's API surface."""
 
     def __init__(self, conf: GraphConfiguration):
@@ -253,11 +284,13 @@ class ComputationGraph:
         self.updater_state: Dict[str, Any] = {}
         self.listeners: List[Any] = []
         self.iteration = 0
-        self.score_value = float("nan")
+        self._score = None  # lazy score_value (LazyScoreMixin)
         self._keys = KeyStream(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
         # output-layer nodes in declared output order
         self.output_nodes = [self.nodes[o] for o in conf.outputs]
+        # streaming rnnTimeStep state: node name -> carry
+        self._rnn_state: Dict[str, Any] = {}
 
     @property
     def layers(self):
@@ -306,14 +339,32 @@ class ComputationGraph:
 
     # ------------------------------------------------------------- forward
     def _forward(self, params, net_state, inputs: Dict[str, jax.Array], *,
-                 train, rng, fmask=None, stop_at_preoutput=True):
+                 train, rng, fmask=None, stop_at_preoutput=True,
+                 carries=None):
         """Fold over topological order.  Output-layer nodes stop at
-        preoutput (loss/activation applied by callers)."""
+        preoutput (loss/activation applied by callers).  ``carries`` maps
+        recurrent node name -> (h, c) initial state; the new carries are
+        returned for TBPTT / rnnTimeStep (reference
+        ``ComputationGraph.rnnActivateUsingStoredState`` :1719)."""
         acts: Dict[str, jax.Array] = dict(inputs)
         new_state = dict(net_state)
+        cd = self.conf.compute_dtype
+        if cd is not None:
+            # mixed precision: cast float leaves into the compute dtype inside
+            # the graph so grads flow back to fp32 params (MLN._forward policy)
+            dt = jnp.dtype(cd)
+
+            def _cast(a):
+                return (a.astype(dt)
+                        if hasattr(a, "dtype")
+                        and jnp.issubdtype(a.dtype, jnp.floating) else a)
+
+            params = jax.tree_util.tree_map(_cast, params)
+            acts = {k: _cast(jnp.asarray(v)) for k, v in acts.items()}
         n_nodes = len(self.topo)
         rngs = jax.random.split(rng, n_nodes) if rng is not None else [None] * n_nodes
         out_names = set(self.conf.outputs)
+        new_carries: Dict[str, Any] = {}
         for i, name in enumerate(self.topo):
             node = self.nodes[name]
             xs = [acts[inp] for inp in node.inputs]
@@ -324,10 +375,12 @@ class ComputationGraph:
                     h = layer.maybe_dropout(xs[0], train=train, rng=rngs[i])
                     acts[name] = layer.pre_output(params[name], h)
                 elif hasattr(layer, "apply_with_carry"):
-                    y, lst, _ = layer.apply_with_carry(
-                        params[name], lstate, xs[0], None,
+                    carry = (carries or {}).get(name)
+                    y, lst, new_carry = layer.apply_with_carry(
+                        params[name], lstate, xs[0], carry,
                         train=train, rng=rngs[i], mask=fmask,
                     )
+                    new_carries[name] = new_carry
                     acts[name] = y
                 else:
                     from deeplearning4j_tpu.nn.layers.convolution import GlobalPoolingLayer
@@ -343,7 +396,7 @@ class ComputationGraph:
                     acts[name] = node.vertex.apply(xs, mask=fmask)
                 else:
                     acts[name] = node.vertex.apply(xs)
-        return acts, new_state
+        return acts, new_state, new_carries
 
     def _loss_fn(self, params, net_state, inputs, labels, rng, fmask=None,
                  lmask=None, carries=None, train=True):
@@ -351,19 +404,23 @@ class ComputationGraph:
         labels: dict output-name->array or single array."""
         inputs = self._as_input_dict(inputs)
         labels = self._as_label_dict(labels)
-        acts, new_state = self._forward(params, net_state, inputs,
-                                        train=train, rng=rng, fmask=fmask)
+        acts, new_state, new_carries = self._forward(
+            params, net_state, inputs, train=train, rng=rng, fmask=fmask,
+            carries=carries)
         total = jnp.zeros(())
         for node in self.output_nodes:
             layer = node.layer
             lm = lmask.get(node.name) if isinstance(lmask, dict) else lmask
+            pre = acts[node.name]
+            if self.conf.compute_dtype is not None:
+                pre = pre.astype(jnp.float32)  # loss in full precision
             total = total + losses_mod.score(
-                layer.loss, labels[node.name], acts[node.name], layer.activation, lm
+                layer.loss, labels[node.name], pre, layer.activation, lm
             )
         for n in self.conf.nodes:
             if n.layer is not None and n.layer.has_params():
                 total = total + n.layer.reg_score(params[n.name])
-        return total, (new_state, None)
+        return total, (new_state, new_carries)
 
     def _as_input_dict(self, inputs):
         if isinstance(inputs, dict):
@@ -391,57 +448,130 @@ class ComputationGraph:
                 if n.layer is not None and n.layer.learning_rate is not None
             }
 
-            def step(params, upd_state, net_state, iteration, inputs, labels, rng, fmask, lmask):
-                (loss, (new_ns, _)), grads = jax.value_and_grad(
+            def step(params, upd_state, net_state, iteration, inputs, labels,
+                     rng, fmask, lmask, carries):
+                (loss, (new_ns, new_carries)), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True
-                )(params, net_state, inputs, labels, rng, fmask, lmask)
+                )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
                 new_params = dict(params)
                 for lname, u in updates.items():
                     new_params[lname] = upd.apply_updates(params[lname], u)
-                return new_params, new_us, new_ns, loss
+                return new_params, new_us, new_ns, loss, new_carries
 
             self._jit_cache["train_step"] = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._jit_cache["train_step"]
 
     def fit(self, data, labels=None, *, fmask=None, lmask=None):
-        """fit(inputs, labels) or fit(iterable of DataSet/tuples)."""
-        if self.conf.backprop_type == "truncated_bptt":
-            raise NotImplementedError(
-                "TBPTT for ComputationGraph lands with the recurrent-graph "
-                "pass; use MultiLayerNetwork for TBPTT or standard backprop here"
-            )
+        """fit(inputs, labels) or fit(iterable of DataSet / MultiDataSet /
+        tuples).  MultiDataSet features/labels map positionally onto
+        ``conf.inputs`` / ``conf.outputs`` (reference
+        ``ComputationGraph.fit(MultiDataSetIterator)`` :599-747)."""
         if labels is not None:
-            self._one_step(data, labels, fmask, lmask)
+            self._fit_one(data, labels, fmask, lmask)
             return self
         for batch in data:
-            if hasattr(batch, "features"):
-                self._one_step(batch.features, batch.labels,
-                               batch.features_mask, batch.labels_mask)
+            if hasattr(batch, "features_masks"):  # MultiDataSet
+                x, y, fm, lm = self._unpack_multi(batch)
+                self._fit_one(x, y, fm, lm)
+            elif hasattr(batch, "features"):
+                self._fit_one(batch.features, batch.labels,
+                              batch.features_mask, batch.labels_mask)
             else:
                 x, y = batch[0], batch[1]
                 fm = batch[2] if len(batch) > 2 else None
                 lm = batch[3] if len(batch) > 3 else None
-                self._one_step(x, y, fm, lm)
+                self._fit_one(x, y, fm, lm)
         return self
 
-    def _one_step(self, x, y, fm, lm):
+    def _unpack_multi(self, mds):
+        """Positional MultiDataSet -> named input/label dicts."""
+        if len(mds.features) != len(self.conf.inputs):
+            raise ValueError(
+                f"MultiDataSet has {len(mds.features)} feature arrays, graph "
+                f"declares {len(self.conf.inputs)} inputs")
+        if len(mds.labels) != len(self.conf.outputs):
+            raise ValueError(
+                f"MultiDataSet has {len(mds.labels)} label arrays, graph "
+                f"declares {len(self.conf.outputs)} outputs")
+        x = dict(zip(self.conf.inputs, mds.features))
+        y = dict(zip(self.conf.outputs, mds.labels))
+        fm = None
+        if mds.features_masks is not None:
+            present = [m for m in mds.features_masks if m is not None]
+            if len(present) > 1:
+                raise ValueError("at most one features mask is supported")
+            fm = present[0] if present else None
+        lm = None
+        if mds.labels_masks is not None:
+            lm = {name: m for name, m in zip(self.conf.outputs, mds.labels_masks)
+                  if m is not None} or None
+        return x, y, fm, lm
+
+    def _fit_one(self, x, y, fm, lm):
         if self.conf.optimization_algo != "stochastic_gradient_descent":
             return self._fit_solver(x, y, fm, lm)
+        if self.conf.backprop_type == "truncated_bptt":
+            return self._fit_tbptt(x, y, fm, lm)
+        self._one_step(x, y, fm, lm, carries=None)
+
+    def _one_step(self, x, y, fm, lm, carries):
         step = self._get_train_step()
         x = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x))
         y = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(y))
-        (self.params, self.updater_state, self.net_state, loss) = step(
+        (self.params, self.updater_state, self.net_state, loss, new_carries) = step(
             self.params, self.updater_state, self.net_state,
             jnp.asarray(float(self.iteration)), x, y, self._keys.next(),
-            None if fm is None else jnp.asarray(fm),
-            None if lm is None else jnp.asarray(lm),
+            None if fm is None else jax.tree_util.tree_map(jnp.asarray, fm),
+            None if lm is None else jax.tree_util.tree_map(jnp.asarray, lm),
+            carries,
         )
-        self.score_value = float(loss)
+        self.score_value = loss  # device scalar; fetched lazily on read
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)
+        return new_carries
+
+    def _fit_tbptt(self, x, y, fm, lm):
+        """Truncated BPTT over the DAG: slice the time axis of every input/
+        label/mask into fwd-length windows, carrying recurrent-node state
+        (detached) across windows (reference ``ComputationGraph``
+        ``doTruncatedBPTT`` :1549)."""
+        x = self._as_input_dict(x)
+        y = self._as_label_dict(y)
+        temporal = [a.shape[1] for a in x.values() if np.ndim(a) >= 3]
+        if not temporal:
+            raise ValueError(
+                "TBPTT requires at least one rank-3 [batch, time, features] "
+                "input; use backprop_type='standard' for feed-forward graphs")
+        T = max(temporal)
+        L = self.conf.tbptt_fwd_length
+
+        def _slice_data(tree, sl):
+            """Time-slice rank-3 sequences; rank-2 arrays are static
+            feed-forward features / one-hot labels, passed whole."""
+            if tree is None:
+                return None
+            return jax.tree_util.tree_map(
+                lambda a: a[:, sl] if np.ndim(a) >= 3 else a, tree)
+
+        def _slice_mask(tree, sl):
+            """Masks are [batch, time] — rank-2 IS temporal here."""
+            if tree is None:
+                return None
+            return jax.tree_util.tree_map(
+                lambda a: a[:, sl] if np.ndim(a) >= 2 else a, tree)
+
+        carries = None
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            carries = self._one_step(
+                _slice_data(x, sl), _slice_data(y, sl),
+                _slice_mask(fm, sl), _slice_mask(lm, sl),
+                carries,
+            )
+            carries = jax.lax.stop_gradient(carries)
 
     def _fit_solver(self, x, y, fm, lm):
         """Full-batch solver path (CG/LBFGS/line-search GD); see
@@ -472,11 +602,14 @@ class ComputationGraph:
             def out(params, net_state, inputs, fmask):
                 from deeplearning4j_tpu.nn import activations
 
-                acts, _ = self._forward(params, net_state, inputs,
-                                        train=False, rng=None, fmask=fmask)
+                acts, _, _ = self._forward(params, net_state, inputs,
+                                           train=False, rng=None, fmask=fmask)
                 outs = []
                 for node in self.output_nodes:
-                    outs.append(activations.get(node.layer.activation)(acts[node.name]))
+                    pre = acts[node.name]
+                    if self.conf.compute_dtype is not None:
+                        pre = pre.astype(jnp.float32)  # fp32 API boundary
+                    outs.append(activations.get(node.layer.activation)(pre))
                 return outs
 
             self._jit_cache["output"] = jax.jit(out)
@@ -501,6 +634,78 @@ class ComputationGraph:
         loss, _ = self._loss_fn(self.params, self.net_state, inputs, labels,
                                 None, fmask=fmask, lmask=lmask, train=False)
         return float(loss)
+
+    # ------------------------------------------------- streaming rnnTimeStep
+    def rnn_clear_previous_state(self):
+        """Reference ``ComputationGraph.rnnClearPreviousState`` :1686."""
+        self._rnn_state = {}
+
+    def rnn_time_step(self, inputs, fmask=None):
+        """Stateful streaming inference (reference
+        ``ComputationGraph.rnnTimeStep`` :1674): feed one (or a few)
+        timesteps; recurrent-node carries persist across calls."""
+        inputs = self._as_input_dict(inputs)
+        inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
+        squeeze = any(v.ndim == 2 for v in inputs.values())
+        if squeeze:
+            inputs = {k: (v[:, None, :] if v.ndim == 2 else v)
+                      for k, v in inputs.items()}
+        carries = self._rnn_state or None
+        acts, _, new_carries = self._forward(
+            self.params, self.net_state, inputs, train=False, rng=None,
+            fmask=fmask, carries=carries,
+        )
+        self._rnn_state = new_carries
+        from deeplearning4j_tpu.nn import activations
+
+        outs = []
+        for node in self.output_nodes:
+            pre = acts[node.name]
+            if self.conf.compute_dtype is not None:
+                pre = pre.astype(jnp.float32)
+            o = activations.get(node.layer.activation)(pre)
+            outs.append(o[:, -1] if squeeze and o.ndim == 3 else o)
+        return outs[0] if len(outs) == 1 else outs
+
+    # -------------------------------------------------------------- pretrain
+    def pretrain(self, batches, epochs: int = 1):
+        """Layerwise unsupervised pretraining of AutoEncoder/RBM layer
+        vertices, in topological order (reference ``ComputationGraph.pretrain``
+        :478: trains each pretrainable vertex on the DAG activations feeding
+        it)."""
+        from deeplearning4j_tpu.nn.layers.autoencoder import AutoEncoder, RBM
+
+        batches = list(batches) if not isinstance(batches, list) else batches
+        for name in self.topo:
+            node = self.nodes[name]
+            if node.layer is None or not isinstance(node.layer, (AutoEncoder, RBM)):
+                continue
+            layer = node.layer
+
+            def ploss(lparams, x, rng, _layer=layer):
+                return _layer.pretrain_loss(lparams, x, rng)
+
+            grad_fn = jax.jit(jax.value_and_grad(ploss))
+            lr = layer.learning_rate or self.conf.updater.learning_rate
+            for _ in range(epochs):
+                for batch in batches:
+                    if hasattr(batch, "features_masks"):
+                        x, _, _, _ = self._unpack_multi(batch)
+                    elif hasattr(batch, "features"):
+                        x = batch.features
+                    else:
+                        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                    x = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x))
+                    # DAG activations feeding this node (test mode, current params)
+                    acts, _, _ = self._forward(self.params, self.net_state, x,
+                                               train=False, rng=None,
+                                               stop_at_preoutput=True)
+                    h = acts[node.inputs[0]]  # _forward seeds acts with inputs
+                    loss, g = grad_fn(self.params[name], h, self._keys.next())
+                    self.params[name] = jax.tree_util.tree_map(
+                        lambda p, gg: p - lr * gg, self.params[name], g
+                    )
+        return self
 
     def set_listeners(self, *listeners):
         self.listeners = list(listeners)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.backend.rng import KeyStream
+from deeplearning4j_tpu.models.common import LazyScoreMixin
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import Layer
@@ -35,7 +36,7 @@ def _is_recurrent(layer) -> bool:
     return hasattr(layer, "apply_with_carry")
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(LazyScoreMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: Tuple[Layer, ...] = conf.layers
@@ -44,7 +45,7 @@ class MultiLayerNetwork:
         self.updater_state: Dict[str, Any] = {}
         self.listeners: List[Any] = []
         self.iteration = 0
-        self.score_value: float = float("nan")
+        self._score = None  # lazy score_value (LazyScoreMixin)
         self._keys = KeyStream(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
         # streaming rnnTimeStep state: layer_name -> carry
@@ -263,7 +264,7 @@ class MultiLayerNetwork:
             None if lm is None else jnp.asarray(lm),
             carries,
         )
-        self.score_value = float(loss)
+        self.score_value = loss  # device scalar; fetched lazily on read
         self.iteration += 1
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration)

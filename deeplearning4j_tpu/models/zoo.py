@@ -89,7 +89,8 @@ def resnet50(height: int = 224, width: int = 224, channels: int = 3,
              n_classes: int = 1000, seed: int = 12345,
              updater: str = "nesterovs", lr: float = 0.1,
              blocks: Sequence[int] = (3, 4, 6, 3),
-             stem_stride: int = 2, init_channels: int = 64) -> ComputationGraph:
+             stem_stride: int = 2, init_channels: int = 64,
+             compute_dtype: Optional[str] = None) -> ComputationGraph:
     """ResNet-50 as a ComputationGraph (residual adds = ElementWiseVertex,
     the reference's DAG capability exercised at benchmark scale).
 
@@ -102,6 +103,8 @@ def resnet50(height: int = 224, width: int = 224, channels: int = 3,
         .add_inputs("input")
         .set_input_types(input=InputType.convolutional(height, width, channels))
     )
+    if compute_dtype:
+        b.compute_dtype(compute_dtype)
     stem_kernel = (7, 7) if stem_stride == 2 else (3, 3)
     stem_pad = (3, 3) if stem_stride == 2 else (1, 1)
     b.add_layer("stem", ConvolutionLayer(

@@ -175,7 +175,7 @@ class ParallelWrapper:
         net.updater_state = jax.tree_util.tree_map(lambda a: a[0], upd_k)
         net.net_state = jax.tree_util.tree_map(lambda a: a[0], ns_k)
         if last_losses is not None:
-            net.score_value = float(np.asarray(last_losses)[-1].mean())
+            net.score_value = last_losses[-1].mean()  # device scalar; lazy
         self.iteration = it - net.iteration
         net.iteration = it
         return net

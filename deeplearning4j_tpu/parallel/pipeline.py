@@ -1,29 +1,42 @@
-"""Pipeline parallelism — GPipe-style stage placement with microbatching.
+"""Pipeline parallelism — GPipe-style microbatching over a 'pipe' mesh axis.
 
 Beyond-reference extension (SURVEY.md §2: PP absent in the reference).
 
-Design: the layer stack is split into S stages balanced by parameter
-count; stage s's parameters live on device s.  A global batch is cut into
-M microbatches; the forward enqueues (microbatch, stage) work in schedule
-order and JAX's async dispatch overlaps them — while microbatch m runs on
-stage s, microbatch m+1 runs on stage s-1, exactly the GPipe fill/drain
-diagram, with activation transfers riding ICI on real hardware.  The
-backward replays the schedule in reverse through stored ``jax.vjp``
-pullbacks, accumulating per-stage gradients on their home devices; the
-updater then applies per stage with no cross-device parameter traffic.
+Two execution paths:
 
-Scope: sequential stateless nets (no BatchNorm running stats, no masks,
-no TBPTT) — conv/dense/activation/attention/layernorm stacks.  Compose
-with DP/TP by using those masters; this one owns the pipe axis.
+- **Compiled** (the TPU path): when the net contains a periodic run of
+  identical-structure layers (the transformer/MLP-block case every real
+  pipeline targets), the ENTIRE schedule compiles to one XLA program —
+  ``shard_map`` over a 1-D 'pipe' mesh, block params stacked [S, ...] and
+  sharded stage-per-device, ``lax.scan`` over M + S - 1 ticks with
+  ``lax.ppermute`` moving activations to the next stage each tick.  While
+  microbatch m sits in stage s, microbatch m+1 computes in stage s-1 —
+  the GPipe fill/drain diagram as dataflow inside the compiler, not as a
+  Python loop: one compilation per config, no host-held pullbacks, and
+  gradients flow through the ppermute chain via AD (its transpose is the
+  reverse rotation).  Non-periodic head/tail layers run replicated, with
+  their contributions masked to stage 0 / stage S-1 and grads psum'd.
+
+- **Orchestrated** (generality fallback): heterogeneous stacks run the
+  schedule as per-stage ``jax.vjp`` calls with explicit device placement —
+  correct for any stateless sequential net, at interpreter dispatch cost.
+
+Scope (both paths): sequential stateless nets (no BatchNorm running
+stats, no masks, no TBPTT, no dropout).  Compose with DP/TP via those
+masters; this one owns the pipe axis.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
 
 from deeplearning4j_tpu.optimize import updaters as upd
 from deeplearning4j_tpu.parallel.training_master import TrainingMaster
@@ -59,6 +72,35 @@ def split_stages(net, n_stages: int) -> List[List[int]]:
     return stages
 
 
+def _layer_sig(layer) -> str:
+    """Structural signature: full layer config minus identity — two layers
+    with equal signatures are interchangeable pipeline-stage material."""
+    d = layer.to_dict()
+    d.pop("name", None)
+    return json.dumps(d, sort_keys=True)
+
+
+def find_periodic_run(sigs: List[str], n_stages: int) -> Optional[Tuple[int, int, int]]:
+    """Longest run ``layers[start : start + period * blocks]`` whose signature
+    sequence repeats with ``period``, with ``blocks`` a positive multiple of
+    ``n_stages``.  Returns (start, period, blocks) or None."""
+    n = len(sigs)
+    best = None
+    for period in range(1, n // 2 + 1):
+        for start in range(0, n - 2 * period + 1):
+            blocks = 1
+            while (start + (blocks + 1) * period <= n and
+                   sigs[start + blocks * period : start + (blocks + 1) * period]
+                   == sigs[start : start + period]):
+                blocks += 1
+            blocks -= blocks % n_stages
+            if blocks >= n_stages and blocks >= 2:
+                size = blocks * period
+                if best is None or size > best[1] * best[2]:
+                    best = (start, period, blocks)
+    return best
+
+
 class PipelineParallelTrainingMaster(TrainingMaster):
     def __init__(self, n_stages: Optional[int] = None,
                  n_microbatches: int = 4,
@@ -86,6 +128,18 @@ class PipelineParallelTrainingMaster(TrainingMaster):
     # ------------------------------------------------------------- stage fns
     def _build(self, net):
         self._validate(net)
+        self._mode = "orchestrated"
+        cfg = net.conf.updater
+        lr_overrides = {l.name: l.learning_rate for l in net.layers
+                        if l.learning_rate is not None}
+        if (self.n_stages > 1 and not lr_overrides
+                and cfg.gradient_normalization in (None, "none")):
+            run = find_periodic_run([_layer_sig(l) for l in net.layers],
+                                    self.n_stages)
+            if run is not None and run[0] + run[1] * run[2] < len(net.layers):
+                self._build_compiled(net, run)
+                self._built = True
+                return
         self.stages = split_stages(net, self.n_stages)
         self.stage_layers = [[net.layers[i] for i in s] for s in self.stages]
         out_layer = net.layers[-1]
@@ -131,11 +185,209 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         names = [net.layers[i].name for i in self.stages[s]]
         return {n: net.params[n] for n in names if n in net.params}
 
+    # ------------------------------------------------------ compiled schedule
+    def _build_compiled(self, net, run):
+        """One-XLA-program GPipe: see module docstring.  Layers split as
+        prefix | S stages x (blocks/S x period layers) | suffix; block params
+        stack to [S, ...] leaves sharded over the 'pipe' mesh axis."""
+        start, period, blocks = run
+        S = self.n_stages
+        per_stage = (blocks // S) * period
+        seg = list(net.layers[start : start + blocks * period])
+        self._pfx = list(net.layers[:start])
+        self._sfx = list(net.layers[start + blocks * period:])
+        self._stage_groups = [seg[s * per_stage : (s + 1) * per_stage]
+                              for s in range(S)]
+        self._template = self._stage_groups[0]
+        from deeplearning4j_tpu.nn.layers.dense import OutputLayer as _Out
+
+        if not self._sfx or not isinstance(self._sfx[-1], _Out):
+            raise ValueError("pipeline suffix must end in an OutputLayer")
+        self._mesh = Mesh(np.asarray(self.devices[:S]), ("pipe",))
+        self._blk_sharding = NamedSharding(self._mesh, P("pipe"))
+        self._repl_sharding = NamedSharding(self._mesh, P())
+        self._upd_cfg = net.conf.updater
+        self._mode = "compiled"
+        self._compiled_steps = {}  # (xs.shape, ys.shape) -> jitted step
+
+    # --- facade <-> pipeline param tree conversion (keys: pfx/ blk/ sfx/)
+    def _stack_tree(self, per_layer: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for l in self._pfx:
+            if l.name in per_layer:
+                out[f"pfx/{l.name}"] = per_layer[l.name]
+        for j in range(len(self._template)):
+            trees = [per_layer.get(g[j].name, {}) for g in self._stage_groups]
+            if trees[0]:
+                out[f"blk/{j}"] = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *trees)
+        for l in self._sfx:
+            if l.name in per_layer:
+                out[f"sfx/{l.name}"] = per_layer[l.name]
+        return out
+
+    def _unstack_tree(self, tree: Dict[str, Any]) -> Dict[str, Any]:
+        out = {}
+        for k, v in tree.items():
+            kind, _, tail = k.partition("/")
+            if kind == "blk":
+                j = int(tail)
+                for s, g in enumerate(self._stage_groups):
+                    out[g[j].name] = jax.tree_util.tree_map(
+                        lambda a: a[s], v)
+            else:
+                out[tail] = v
+        return out
+
+    def _make_compiled_step(self, net, x_mb_shape, x_dtype):
+        S = self.n_stages
+        M = self.n_microbatches
+        mesh = self._mesh
+        cfg = self._upd_cfg
+        pfx, sfx, template = self._pfx, self._sfx, self._template
+        out_layer = sfx[-1]
+
+        def prefix_fwd(tree, a):
+            for l in pfx:
+                a, _ = l.apply(tree.get(f"pfx/{l.name}", {}), {}, a,
+                               train=True, rng=None)
+            return a
+
+        def stage_fwd(blk, a):
+            for j, l in enumerate(template):
+                a, _ = l.apply(blk.get(f"blk/{j}", {}), {}, a,
+                               train=True, rng=None)
+            return a
+
+        def suffix_loss(tree, a, y):
+            for l in sfx[:-1]:
+                a, _ = l.apply(tree.get(f"sfx/{l.name}", {}), {}, a,
+                               train=True, rng=None)
+            return out_layer.score(tree[f"sfx/{out_layer.name}"], a, y)
+
+        # static activation shape: block io shape == prefix output shape
+        pfx_tree = {k: v for k, v in self._stack_tree(net.params).items()
+                    if k.startswith("pfx/")}
+        probe = jax.eval_shape(prefix_fwd, pfx_tree,
+                               jax.ShapeDtypeStruct(x_mb_shape, x_dtype))
+
+        def spmd(pfx_p, blk_p, sfx_p, xs, ys):
+            idx = lax.axis_index("pipe")
+            blk_local = jax.tree_util.tree_map(lambda a: a[0], blk_p)
+            perm = [(i, i + 1) for i in range(S - 1)]
+
+            def local_loss(pfx_p, blk_local, sfx_p):
+                state0 = jnp.zeros(probe.shape, probe.dtype)
+                state0 = lax.pcast(state0, ("pipe",), to="varying")
+
+                def tick(carry, t):
+                    state, loss_sum = carry
+                    a0 = prefix_fwd(pfx_p, xs[jnp.clip(t, 0, M - 1)])
+                    inp = jnp.where(idx == 0, a0, state)
+                    outv = stage_fwd(blk_local, inp)
+                    m_out = t - (S - 1)
+                    l = suffix_loss(sfx_p, outv,
+                                    ys[jnp.clip(m_out, 0, M - 1)])
+                    loss_sum = loss_sum + jnp.where(
+                        (idx == S - 1) & (m_out >= 0), l, 0.0)
+                    state = lax.ppermute(outv, "pipe", perm)
+                    return (state, loss_sum), None
+
+                loss0 = lax.pcast(jnp.zeros(()), ("pipe",), to="varying")
+                (_, loss_sum), _ = lax.scan(
+                    tick, (state0, loss0), jnp.arange(M + S - 1))
+                # LOCAL loss only (nonzero on the last stage).  Differentiating
+                # the psum'd total would double-count: every device's output
+                # would back-propagate cotangents into every stage's params.
+                return loss_sum / M
+
+            loss, (gp, gb, gs) = jax.value_and_grad(
+                local_loss, argnums=(0, 1, 2))(pfx_p, blk_local, sfx_p)
+            loss = lax.psum(loss, "pipe")
+            gp = lax.psum(gp, "pipe")
+            gs = lax.psum(gs, "pipe")
+            gb = jax.tree_util.tree_map(lambda a: a[None], gb)
+            return loss, gp, gb, gs
+
+        repl, piped = P(), P("pipe")
+        sharded = shard_map(
+            spmd, mesh=mesh,
+            in_specs=(repl, piped, repl, repl, repl),
+            out_specs=(repl, repl, piped, repl),
+            check_vma=False,
+        )
+        reg_layers = ([(f"pfx/{l.name}", l) for l in pfx if l.has_params()]
+                      + [(f"blk/{j}", l) for j, l in enumerate(template)
+                         if l.has_params()]
+                      + [(f"sfx/{l.name}", l) for l in sfx if l.has_params()])
+
+        def reg_fn(tree):
+            r = jnp.zeros(())
+            for key, l in reg_layers:
+                if key in tree:
+                    r = r + l.reg_score(tree[key])
+            return r
+
+        def step(tree, opt_state, it, xs, ys):
+            pfx_p = {k: v for k, v in tree.items() if k.startswith("pfx/")}
+            blk_p = {k: v for k, v in tree.items() if k.startswith("blk/")}
+            sfx_p = {k: v for k, v in tree.items() if k.startswith("sfx/")}
+            loss, gp, gb, gs = sharded(pfx_p, blk_p, sfx_p, xs, ys)
+            reg_val, reg_g = jax.value_and_grad(reg_fn)(tree)
+            grads = {**gp, **gb, **gs}
+            grads = jax.tree_util.tree_map(jnp.add, grads,
+                                           {k: reg_g[k] for k in grads})
+            updates, new_opt = upd.update(cfg, grads, opt_state, it, {})
+            new_tree = {
+                k: (upd.apply_updates(v, updates[k]) if k in updates else v)
+                for k, v in tree.items()
+            }
+            return new_tree, new_opt, loss + reg_val
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _execute_compiled(self, net, iterator):
+        M = self.n_microbatches
+        tree = self._stack_tree(net.params)
+        opt_state = {slot: self._stack_tree(per_layer)
+                     for slot, per_layer in net.updater_state.items()}
+        place = lambda t: {
+            k: jax.device_put(v, self._blk_sharding if k.startswith("blk/")
+                              else self._repl_sharding)
+            for k, v in t.items()}
+        tree = place(tree)
+        opt_state = {slot: place(t) for slot, t in opt_state.items()}
+        for ds in iterator:
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                raise ValueError("pipeline master does not support masked batches")
+            x = np.asarray(ds.features)
+            y = np.asarray(ds.labels)
+            if len(x) % M:
+                raise ValueError(f"batch {len(x)} not divisible by "
+                                 f"{M} microbatches")
+            xs = jnp.asarray(x.reshape((M, len(x) // M) + x.shape[1:]))
+            ys = jnp.asarray(y.reshape((M, len(y) // M) + y.shape[1:]))
+            key = (xs.shape, ys.shape)  # probe shape is batch-dependent
+            if key not in self._compiled_steps:
+                self._compiled_steps[key] = self._make_compiled_step(
+                    net, xs.shape[1:], xs.dtype)
+            tree, opt_state, loss = self._compiled_steps[key](
+                tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
+            net.score_value = loss  # device scalar; fetched lazily on read
+            net.iteration += 1
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration)
+        net.params.update(self._unstack_tree(tree))
+        for slot, t in opt_state.items():
+            net.updater_state[slot].update(self._unstack_tree(t))
+
     # ---------------------------------------------------------------- train
     def execute_training(self, net, iterator):
 
         if not self._built:
             self._build(net)
+        if self._mode == "compiled":
+            return self._execute_compiled(net, iterator)
         S = len(self.stages)
         # place each stage's params + updater state on its device
         stage_params = [
@@ -152,7 +404,7 @@ class PipelineParallelTrainingMaster(TrainingMaster):
 
         for ds in iterator:
             loss = self._train_batch(net, ds, stage_params, stage_upd)
-            net.score_value = float(loss)
+            net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration)

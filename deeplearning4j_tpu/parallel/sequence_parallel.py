@@ -241,7 +241,7 @@ class SequenceParallelTrainingMaster:
             params, upd_state, ns, loss = self._step(
                 params, upd_state, ns, jnp.asarray(float(net.iteration)),
                 xj, yj, net._keys.next())
-            net.score_value = float(loss)
+            net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             if self.collect_stats:
                 self._stats["step_time_ms"].append((time.perf_counter() - t0) * 1e3)

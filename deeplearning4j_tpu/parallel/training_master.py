@@ -155,7 +155,7 @@ class SyncTrainingMaster(TrainingMaster):
                 params, upd_state, ns, jnp.asarray(float(net.iteration)),
                 x, y, net._keys.next(), fm, lm,
             )
-            net.score_value = float(loss)
+            net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             if self.collect_stats:
                 jax.block_until_ready(loss)

@@ -1,0 +1,246 @@
+"""ComputationGraph runtime parity with MultiLayerNetwork: recurrent DAGs,
+TBPTT fit, rnnTimeStep streaming, pretrain, MultiDataSet iterators.
+
+Reference: ``ComputationGraph.java`` :599-747 (fit MultiDataSetIterator),
+:1549 (doTruncatedBPTT), :1674 (rnnTimeStep), :478 (pretrain);
+``RecordReaderMultiDataSetIterator.java``; ``AsyncMultiDataSetIterator.java``.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.multidataset import (
+    AsyncMultiDataSetIterator,
+    ListMultiDataSetIterator,
+    MultiDataSet,
+    RecordReaderMultiDataSetIterator,
+)
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.models.graph import ComputationGraph
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, GravesLSTM, OutputLayer, RnnOutputLayer,
+)
+
+F64 = jnp.float64
+
+
+def _lstm_graph(tbptt=None, seed=3, lr=0.05, hidden=4, vocab=3):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=lr).graph()
+         .add_inputs("in")
+         .add_layer("lstm", GravesLSTM(n_in=vocab, n_out=hidden,
+                                       activation="tanh"), "in")
+         .add_layer("out", RnnOutputLayer(n_in=hidden, n_out=vocab,
+                                          loss="mcxent", activation="softmax"),
+                    "lstm")
+         .set_outputs("out"))
+    if tbptt:
+        b = b.backprop_type("truncated_bptt", fwd_length=tbptt,
+                            back_length=tbptt)
+    return ComputationGraph(b.build()).init()
+
+
+def _seq_data(rs, b=2, t=6, vocab=3, dtype=np.float32):
+    ids = rs.randint(0, vocab, (b, t))
+    x = np.eye(vocab, dtype=dtype)[ids]
+    y = np.eye(vocab, dtype=dtype)[np.roll(ids, -1, 1)]
+    return x, y
+
+
+def test_graph_lstm_gradients():
+    """CG analog of test_graves_lstm_gradients (CuDNNGradientChecks style)."""
+    rs = np.random.RandomState(46)
+    net = _lstm_graph()
+    net = ComputationGraph(net.conf).init(dtype=F64)
+    x = rs.randn(2, 5, 3)
+    y = np.eye(3)[rs.randint(0, 3, (2, 5))]
+    assert check_gradients(net, x, y, max_params_per_array=32)
+
+
+def test_graph_tbptt_equivalence():
+    """One TBPTT pass with window == T must equal one standard fit step."""
+    rs = np.random.RandomState(7)
+    x, y = _seq_data(rs, b=2, t=6)
+    std = _lstm_graph(tbptt=None, seed=11)
+    tb = _lstm_graph(tbptt=6, seed=11)
+    std.fit(x, y)
+    tb.fit(x, y)
+    assert np.allclose(std.params_to_vector(), tb.params_to_vector(),
+                       atol=1e-6), "window==T TBPTT diverged from standard fit"
+
+
+def test_graph_tbptt_trains_and_carries():
+    """Window < T: multiple windows per batch, state carried, loss drops."""
+    rs = np.random.RandomState(8)
+    x, y = _seq_data(rs, b=4, t=12)
+    net = _lstm_graph(tbptt=4, seed=5, lr=0.1)
+    net.fit(x, y)
+    first = net.score_value
+    # 3 windows of 4 -> 3 optimizer steps for one batch
+    assert net.iteration == 3
+    for _ in range(30):
+        net.fit(x, y)
+    assert net.score_value < first
+
+
+def test_graph_rnn_time_step_matches_full_forward():
+    """Feeding T steps one at a time == one full-sequence forward
+    (reference rnnTimeStep contract, ComputationGraph.java:1674)."""
+    rs = np.random.RandomState(9)
+    net = _lstm_graph(seed=13)
+    x, _ = _seq_data(rs, b=2, t=5)
+    full = np.asarray(net.output(x))
+    net.rnn_clear_previous_state()
+    stepped = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(5)]
+    for t in range(5):
+        assert np.allclose(full[:, t], stepped[t], atol=1e-5), f"t={t}"
+    # clearing state restarts the stream
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, 0]))
+    assert np.allclose(again, stepped[0], atol=1e-6)
+
+
+def test_graph_tbptt_masking():
+    """Masked TBPTT fit runs and produces finite loss (CG analog of the
+    masking gradient tests)."""
+    rs = np.random.RandomState(10)
+    x, y = _seq_data(rs, b=2, t=8)
+    mask = np.ones((2, 8), np.float32)
+    mask[0, 5:] = 0.0
+    net = _lstm_graph(tbptt=4, seed=17)
+    net.fit(x, y, fmask=mask, lmask=mask)
+    assert np.isfinite(net.score_value)
+
+
+def test_graph_pretrain_autoencoder():
+    from deeplearning4j_tpu.nn.layers import AutoEncoder
+
+    rs = np.random.RandomState(11)
+    b = (NeuralNetConfiguration.builder().seed(19)
+         .updater("sgd", learning_rate=0.1).graph()
+         .add_inputs("in")
+         .add_layer("ae", AutoEncoder(n_in=8, n_out=4, activation="sigmoid",
+                                      learning_rate=0.1), "in")
+         .add_layer("out", OutputLayer(n_in=4, n_out=2), "ae")
+         .set_outputs("out"))
+    net = ComputationGraph(b.build()).init()
+    x = rs.rand(32, 8).astype(np.float32)
+    before = {k: np.asarray(v) for k, v in net.params["ae"].items()}
+    net.pretrain([(x, None)], epochs=3)
+    after = net.params["ae"]
+    assert any(not np.allclose(before[k], np.asarray(after[k]))
+               for k in before), "pretrain did not move AE params"
+
+
+# --------------------------------------------------------- MultiDataSet path
+
+def _two_input_graph(seed=23):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("adam", learning_rate=0.05).graph()
+         .add_inputs("a", "b"))
+    from deeplearning4j_tpu.models.vertices import MergeVertex
+
+    b.add_layer("da", DenseLayer(n_in=3, n_out=8, activation="relu"), "a")
+    b.add_layer("db", DenseLayer(n_in=2, n_out=8, activation="relu"), "b")
+    b.add_vertex("m", MergeVertex(), "da", "db")
+    b.add_layer("out", OutputLayer(n_in=16, n_out=2), "m")
+    return ComputationGraph(b.set_outputs("out").build()).init()
+
+
+def _multi_data(rs, n=64):
+    xa = rs.rand(n, 3).astype(np.float32)
+    xb = rs.rand(n, 2).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[((xa.sum(1) + xb.sum(1)) > 2.5).astype(int)]
+    return MultiDataSet((xa, xb), (y,))
+
+
+def test_graph_fit_multidataset_iterator():
+    rs = np.random.RandomState(12)
+    mds = _multi_data(rs)
+    net = _two_input_graph()
+    it = ListMultiDataSetIterator(mds, batch_size=16)
+    for _ in range(30):
+        net.fit(it)
+    out = net.output({"a": mds.features[0], "b": mds.features[1]})
+    acc = (np.asarray(out).argmax(-1) == mds.labels[0].argmax(-1)).mean()
+    assert acc > 0.85, acc
+
+
+def test_graph_fit_async_multidataset():
+    rs = np.random.RandomState(13)
+    mds = _multi_data(rs)
+    net = _two_input_graph(seed=29)
+    it = AsyncMultiDataSetIterator(ListMultiDataSetIterator(mds, 16),
+                                  prefetch_size=2)
+    for _ in range(5):
+        net.fit(it)
+    assert np.isfinite(net.score_value)
+    assert net.iteration == 20  # 4 batches x 5 epochs
+
+
+def test_multidataset_mismatch_raises():
+    rs = np.random.RandomState(14)
+    net = _two_input_graph(seed=31)
+    bad = MultiDataSet((rs.rand(4, 3).astype(np.float32),),
+                       (np.eye(2, dtype=np.float32)[[0, 1, 0, 1]],))
+    with pytest.raises(ValueError, match="feature arrays"):
+        net.fit(ListMultiDataSetIterator(bad, 4))
+
+
+def test_record_reader_multidataset_iterator():
+    from deeplearning4j_tpu.datasets.datavec import CollectionRecordReader
+
+    rs = np.random.RandomState(15)
+    rows = [list(rs.rand(5).astype(float)) + [float(rs.randint(0, 2))]
+            for _ in range(20)]
+    it = (RecordReaderMultiDataSetIterator.builder(batch_size=8)
+          .add_reader("r", CollectionRecordReader(rows))
+          .add_input("r", 0, 2)
+          .add_input("r", 3, 4)
+          .add_output_one_hot("r", 5, 2)
+          .build())
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features[0].shape == (8, 3)
+    assert batches[0].features[1].shape == (8, 2)
+    assert batches[0].labels[0].shape == (8, 2)
+    assert batches[2].features[0].shape == (4, 3)  # short last batch kept
+    # one-hot is exact
+    assert set(np.unique(batches[0].labels[0])) <= {0.0, 1.0}
+    # reset replays
+    it.reset()
+    again = list(it)
+    assert len(again) == 3
+    assert np.allclose(again[0].features[0], batches[0].features[0])
+
+
+def test_async_iterator_surfaces_producer_errors():
+    """A failing underlying iterator must raise on the consumer side, not
+    silently truncate the epoch."""
+
+    class Exploding(ListMultiDataSetIterator):
+        def next(self):
+            if self._pos >= 1:
+                raise IOError("corrupt record")
+            return super().next()
+
+    rs = np.random.RandomState(17)
+    it = AsyncMultiDataSetIterator(Exploding(_multi_data(rs, 32), 8))
+    batches = []
+    with pytest.raises(RuntimeError, match="async prefetch producer failed"):
+        while it.has_next():
+            batches.append(it.next())
+    assert len(batches) == 1
+
+
+def test_multidataset_merge_and_shuffle():
+    rs = np.random.RandomState(16)
+    a, b = _multi_data(rs, 8), _multi_data(rs, 8)
+    m = MultiDataSet.merge([a, b])
+    assert len(m) == 16
+    s = m.shuffle(np.random.RandomState(0))
+    assert len(s) == 16
+    assert not np.allclose(s.features[0], m.features[0])

@@ -1,0 +1,87 @@
+"""Lazy score_value: training loops must not host-sync per step.
+
+Reference contrast: the reference pushes a host double to listeners every
+iteration (``BaseOptimizer.java`` score update); on TPU that per-step
+``float(loss)`` serializes dispatch.  Here the device scalar is stored
+as-is and fetched only on read (``models/common.py``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+
+def _net(seed=0):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=0.1).list()
+         .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+         .layer(OutputLayer(n_in=16, n_out=3)).build())
+    ).init()
+
+
+def _data(rng, n=64):
+    x = rng.rand(n, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, n)]
+    return x, y
+
+
+def test_fit_keeps_loss_on_device(rng):
+    net = _net()
+    x, y = _data(rng)
+    net.fit(ListDataSetIterator(DataSet(x, y), 16))
+    # the loop stored the raw device scalar — proof no float() ran per step
+    assert isinstance(net._score, jax.Array)
+    assert not isinstance(net._score, float)
+
+
+def test_score_value_fetches_and_caches(rng):
+    net = _net()
+    x, y = _data(rng)
+    net.fit(x, y)
+    first = net.score_value
+    assert np.isfinite(first)
+    # after the read, the fetched float is cached
+    assert isinstance(net._score, float)
+    assert net.score_value == first
+
+
+def test_score_value_nan_before_training():
+    net = _net()
+    assert np.isnan(net.score_value)
+
+
+def test_listener_reads_still_work(rng):
+    from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+
+    net = _net()
+    lst = CollectScoresIterationListener(frequency=1)
+    net.set_listeners(lst)
+    x, y = _data(rng)
+    net.fit(ListDataSetIterator(DataSet(x, y), 16))
+    assert len(lst.scores) == 4
+    assert all(np.isfinite(s) for _, s in lst.scores)
+
+
+def test_graph_fit_keeps_loss_on_device(rng):
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.1).graph()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=8, n_out=16, activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_in=16, n_out=3), "d")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    x, y = _data(rng)
+    net.fit(x, y)
+    assert isinstance(net._score, jax.Array)
+    assert np.isfinite(net.score_value)

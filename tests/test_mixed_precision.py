@@ -63,6 +63,51 @@ def test_compute_dtype_validation():
         NeuralNetConfiguration.builder().list().compute_dtype("int8")
 
 
+def build_graph(compute_dtype=None, seed=5):
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("adam", learning_rate=0.05).graph()
+         .add_inputs("in")
+         .add_layer("d", DenseLayer(n_in=4, n_out=32, activation="relu"), "in")
+         .add_layer("out", OutputLayer(n_in=32, n_out=2, loss="mcxent",
+                                       activation="softmax"), "d")
+         .set_outputs("out"))
+    if compute_dtype:
+        b = b.compute_dtype(compute_dtype)
+    return ComputationGraph(b.build()).init()
+
+
+def test_graph_bf16_trains_params_stay_fp32():
+    net = build_graph("bfloat16")
+    x, y = task_data()
+    for _ in range(60):
+        net.fit(x, y)
+    assert net.params["d"]["W"].dtype == jnp.float32
+    out = np.asarray(net.output(x))
+    assert out.dtype == np.float32  # fp32 API boundary
+    acc = (out.argmax(-1) == y.argmax(-1)).mean()
+    assert acc > 0.9, acc
+    assert np.isfinite(net.score_value)
+
+
+def test_graph_bf16_close_to_fp32():
+    x, y = task_data()
+    a, b = build_graph("bfloat16"), build_graph(None)
+    for _ in range(20):
+        a.fit(x, y)
+        b.fit(x, y)
+    assert abs(a.score_value - b.score_value) < 0.05
+
+
+def test_graph_compute_dtype_serializes():
+    from deeplearning4j_tpu.models.graph import GraphConfiguration
+
+    conf = build_graph("bfloat16").conf
+    back = GraphConfiguration.from_json(conf.to_json())
+    assert back.compute_dtype == "bfloat16"
+
+
 def test_viterbi_decode_prefers_transitions():
     # emissions say state 1 at t=1 only weakly; strong self-transitions
     # keep the path in state 0

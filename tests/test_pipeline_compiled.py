@@ -1,0 +1,147 @@
+"""Compiled pipeline schedule: one XLA program (shard_map + scan + ppermute)
+for homogeneous-block nets; equivalence to serial training is the oracle
+(the reference's distributed-vs-local pattern, SURVEY.md §4).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (
+    DistributedNetwork, PipelineParallelTrainingMaster,
+)
+from deeplearning4j_tpu.parallel.pipeline import find_periodic_run, _layer_sig
+
+
+def block_mlp(n_blocks=4, width=16, seed=7, updater="sgd", lr=0.2, l2=0.0):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater, learning_rate=lr).list()
+         .layer(DenseLayer(n_in=8, n_out=width, activation="tanh", l2=l2)))
+    for _ in range(n_blocks):
+        b.layer(DenseLayer(n_in=width, n_out=width, activation="tanh", l2=l2))
+    b.layer(OutputLayer(n_in=width, n_out=4, l2=l2))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def data(n=32, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+    return x, y
+
+
+def test_find_periodic_run():
+    net = block_mlp(n_blocks=4)
+    sigs = [_layer_sig(l) for l in net.layers]
+    run = find_periodic_run(sigs, 4)
+    assert run == (1, 1, 4)
+    # with 2 stages, the 4-block run still qualifies
+    assert find_periodic_run(sigs, 2) == (1, 1, 4)
+    # no run long enough for 8 stages
+    assert find_periodic_run(sigs, 8) is None
+
+
+def _fit_pp(net, x, y, n_stages, n_micro, epochs=2):
+    master = PipelineParallelTrainingMaster(
+        n_stages=n_stages, n_microbatches=n_micro,
+        devices=jax.devices()[:n_stages])
+    DistributedNetwork(net, master).fit(
+        ListDataSetIterator(DataSet(x, y), len(x)), epochs=epochs)
+    return master
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 2), (4, 4)])
+def test_compiled_pipeline_matches_serial(n_stages, n_micro):
+    x, y = data(32)
+    serial = block_mlp()
+    serial.fit(x, y)
+    serial.fit(x, y)
+
+    pp_net = block_mlp()
+    master = _fit_pp(pp_net, x, y, n_stages, n_micro)
+    assert master._mode == "compiled"
+    for ln in serial.params:
+        for pn in serial.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[ln][pn]),
+                np.asarray(pp_net.params[ln][pn]), atol=2e-5,
+                err_msg=f"{ln}/{pn}")
+    assert abs(serial.score_value - pp_net.score_value) < 1e-4
+
+
+def test_compiled_pipeline_momentum_state_roundtrips():
+    x, y = data(32)
+    serial = block_mlp(updater="nesterovs", lr=0.1)
+    serial.fit(x, y)
+    serial.fit(x, y)
+    pp_net = block_mlp(updater="nesterovs", lr=0.1)
+    master = _fit_pp(pp_net, x, y, 4, 2)
+    assert master._mode == "compiled"
+    for ln in serial.params:
+        for pn in serial.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[ln][pn]),
+                np.asarray(pp_net.params[ln][pn]), atol=2e-5,
+                err_msg=f"{ln}/{pn}")
+    # updater momentum state mirrored back per layer
+    assert set(serial.updater_state["v"]) == set(pp_net.updater_state["v"])
+
+
+def test_compiled_pipeline_regularization():
+    x, y = data(16)
+    serial = block_mlp(l2=0.01, seed=9)
+    serial.fit(x, y)
+    pp_net = block_mlp(l2=0.01, seed=9)
+    master = _fit_pp(pp_net, x, y, 2, 2, epochs=1)
+    assert master._mode == "compiled"
+    assert abs(serial.score_value - pp_net.score_value) < 1e-5
+
+
+def test_compiled_pipeline_single_compile():
+    x, y = data(32)
+    pp_net = block_mlp(seed=11)
+    master = PipelineParallelTrainingMaster(
+        n_stages=4, n_microbatches=4, devices=jax.devices()[:4])
+    dn = DistributedNetwork(pp_net, master)
+    dn.fit(ListDataSetIterator(DataSet(x, y), len(x)), epochs=3)
+    assert master._mode == "compiled"
+    # one program for the whole config: 3 epochs reuse one compiled step
+    assert len(master._compiled_steps) == 1
+    assert next(iter(master._compiled_steps.values()))._cache_size() == 1
+
+
+def test_compiled_pipeline_handles_batch_size_change():
+    # regression: second fit with a different batch size must rebuild the
+    # schedule for the new microbatch shape, not crash on the stale probe
+    x, y = data(32)
+    pp_net = block_mlp(seed=13)
+    master = PipelineParallelTrainingMaster(
+        n_stages=2, n_microbatches=2, devices=jax.devices()[:2])
+    dn = DistributedNetwork(pp_net, master)
+    dn.fit(ListDataSetIterator(DataSet(x, y), 32))
+    dn.fit(ListDataSetIterator(DataSet(x[:16], y[:16]), 16))
+    assert master._mode == "compiled"
+    assert len(master._compiled_steps) == 2
+    assert np.isfinite(pp_net.score_value)
+
+
+def test_heterogeneous_falls_back_to_orchestrated():
+    b = (NeuralNetConfiguration.builder().seed(3)
+         .updater("sgd", learning_rate=0.1).list()
+         .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
+         .layer(DenseLayer(n_in=16, n_out=12, activation="relu"))
+         .layer(DenseLayer(n_in=12, n_out=8, activation="tanh"))
+         .layer(OutputLayer(n_in=8, n_out=4)))
+    net = MultiLayerNetwork(b.build()).init()
+    x, y = data(16)
+    master = PipelineParallelTrainingMaster(
+        n_stages=2, n_microbatches=2, devices=jax.devices()[:2])
+    DistributedNetwork(net, master).fit(
+        ListDataSetIterator(DataSet(x, y), 16))
+    assert master._mode == "orchestrated"
+    assert np.isfinite(net.score_value)
