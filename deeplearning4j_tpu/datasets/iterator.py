@@ -66,6 +66,7 @@ class ListDataSetIterator(DataSetIterator):
     def __init__(self, data: DataSet, batch_size: int, drop_last: bool = False):
         self._data = data
         self._batch_size = batch_size
+        self._drop_last = drop_last
         self._batches = data.batch_by(batch_size, drop_last)
         self._pos = 0
 

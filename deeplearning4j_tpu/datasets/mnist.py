@@ -14,12 +14,15 @@ Reference: ``deeplearning4j-core/.../datasets/fetchers/MnistDataFetcher.java:40-
 from __future__ import annotations
 
 import gzip
+import logging
 import os
 import struct
 from pathlib import Path
 from typing import Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
@@ -111,6 +114,11 @@ class MnistDataFetcher:
                 raise FileNotFoundError(
                     f"MNIST IDX files not found under {root}; set DL4J_TPU_MNIST_DIR"
                 )
+            _log.warning(
+                "MNIST IDX files not found under %s — using deterministic "
+                "SYNTHETIC digit glyphs (is_synthetic=True). Point "
+                "DL4J_TPU_MNIST_DIR at real IDX files, or pass "
+                "allow_synthetic=False to fail instead.", root)
             n = num_examples or (2048 if train else 512)
             images, labels = _synthetic_mnist(n, seed if train else seed + 1)
         if num_examples is not None:

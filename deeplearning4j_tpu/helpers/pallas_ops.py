@@ -181,6 +181,99 @@ def _bn_inference_impl(x2d, mean, var, gamma, beta, eps):
 
 
 # ---------------------------------------------------------------------------
+# fused BN training: one VMEM pass computing batch mean/var + normalize,
+# one fused backward pass (≙ cudnnBatchNormalizationForwardTraining/Backward)
+# ---------------------------------------------------------------------------
+
+def _bn_train_kernel(x_ref, gamma_ref, beta_ref, y_ref, xhat_ref, stats_ref,
+                     *, eps, M):
+    x = x_ref[:]
+    row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    valid = row < M            # zero-padded rows must not bias the moments
+    xm = jnp.where(valid, x, 0.0)
+    mean = jnp.sum(xm, 0) / M
+    diff = jnp.where(valid, x - mean, 0.0)
+    var = jnp.sum(diff * diff, 0) / M
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (x - mean) * inv
+    y_ref[:] = xhat * gamma_ref[:] + beta_ref[:]
+    xhat_ref[:] = xhat
+    stats_ref[:] = jnp.stack([mean, var, inv])[:, None, :].reshape(3, -1)
+
+
+def _bn_train_bwd_kernel(xhat_ref, g_ref, gammainv_ref, dx_ref, dgb_ref,
+                         *, M):
+    """dx = (gamma*inv/M) * (M*g - Σg - xhat*Σ(g*xhat)); padded rows carry
+    g == 0 so the channel sums are already valid-row sums."""
+    xhat, g = xhat_ref[:], g_ref[:]
+    sum_g = jnp.sum(g, 0)
+    sum_gx = jnp.sum(g * xhat, 0)
+    dx_ref[:] = (gammainv_ref[:] / M) * (M * g - sum_g - xhat * sum_gx)
+    dgb_ref[:] = jnp.stack([sum_gx, sum_g])[:, None, :].reshape(2, -1)
+
+
+def bn_training(x2d, gamma, beta, eps):
+    """Fused training-mode BN: returns (y, batch_mean, batch_var) from one
+    VMEM pass; differentiable via a fused backward kernel (custom VJP).
+    Gradients flow to (x2d, gamma, beta); the returned moments feed the
+    running-stats update, which the reference does not differentiate."""
+    return _bn_training_vjp(x2d, gamma, beta, eps)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _bn_training_vjp(x2d, gamma, beta, eps):
+    y, _, mean, var, _ = _bn_training_fwd_impl(x2d, gamma, beta, eps)
+    return y, mean, var
+
+
+def _bn_training_fwd_impl(x2d, gamma, beta, eps):
+    xp, M, C = _pad2(x2d)
+    Cp = xp.shape[1]
+
+    def pad_c(v):
+        return jnp.pad(v.reshape(1, -1), ((0, 0), (0, Cp - C)))
+
+    kern = functools.partial(_bn_train_kernel, eps=eps, M=M)
+    y, xhat, stats = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct(xp.shape, xp.dtype),
+                   jax.ShapeDtypeStruct(xp.shape, xp.dtype),
+                   jax.ShapeDtypeStruct((3, Cp), xp.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * 3,
+        interpret=_interpret(),
+    )(xp, pad_c(gamma), pad_c(beta))
+    mean, var, inv = stats[0, :C], stats[1, :C], stats[2, :C]
+    return y[:M, :C], xhat, mean, var, inv
+
+
+def _bn_training_fwd_rule(x2d, gamma, beta, eps):
+    y, xhat, mean, var, inv = _bn_training_fwd_impl(x2d, gamma, beta, eps)
+    return (y, mean, var), (xhat, inv, gamma, x2d.shape)
+
+
+def _bn_training_bwd_rule(eps, res, cts):
+    g = cts[0]  # moments feed running stats only: their cotangents are zero
+    xhat_p, inv, gamma, (M, C) = res
+    gp, _, _ = _pad2(g)
+    Cp = xhat_p.shape[1]
+    gammainv = jnp.pad((gamma * inv).reshape(1, -1), ((0, 0), (0, Cp - C)))
+    kern = functools.partial(_bn_train_bwd_kernel, M=M)
+    dx, dgb = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct(xhat_p.shape, xhat_p.dtype),
+                   jax.ShapeDtypeStruct((2, Cp), xhat_p.dtype)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),) * 2,
+        interpret=_interpret(),
+    )(xhat_p, gp, gammainv)
+    return dx[:M, :C], dgb[0, :C], dgb[1, :C]
+
+
+_bn_training_vjp.defvjp(_bn_training_fwd_rule, _bn_training_bwd_rule)
+
+
+# ---------------------------------------------------------------------------
 # helper objects + registration
 # ---------------------------------------------------------------------------
 
@@ -212,7 +305,7 @@ class PallasLRNHelper:
 
 
 class PallasBatchNormHelper:
-    """≙ ``CudnnBatchNormalizationHelper`` (inference path)."""
+    """≙ ``CudnnBatchNormalizationHelper`` (inference + training paths)."""
 
     name = "PallasBatchNormHelper"
 
@@ -223,6 +316,14 @@ class PallasBatchNormHelper:
         shape = x.shape
         x2d = x.reshape(-1, shape[-1])
         return bn_inference(x2d, mean, var, gamma, beta, float(eps)).reshape(shape)
+
+    def apply_training(self, x, gamma, beta, eps):
+        """Fused forward-training pass; returns (y, batch_mean, batch_var)
+        (≙ cudnnBatchNormalizationForwardTraining's saved moments)."""
+        shape = x.shape
+        x2d = x.reshape(-1, shape[-1])
+        y, mean, var = bn_training(x2d, gamma, beta, float(eps))
+        return y.reshape(shape), mean, var
 
 
 def register_default_helpers() -> None:

@@ -5,8 +5,9 @@ Reference: ``util/ModelSerializer.java:32-95``: a zip holding
 ``updaterState.bin``.  Same container here (plus ``netState.npz`` for BN
 running stats and a manifest), so the capability — one portable file,
 config round-trip, resume with optimizer state — is identical.  Large-scale
-sharded checkpoints use orbax through ``parallel/checkpoint.py``; this
-single-file format is the ModelSerializer-parity path.
+mesh-sharded checkpoints (per-host shard files, resumable, any-mesh
+restore) live in ``parallel/checkpoint.py``; this single-file format is the
+ModelSerializer-parity path.
 """
 
 from __future__ import annotations

@@ -124,7 +124,12 @@ long csv_parse(const char* buf, long len, char delim, long skip_lines,
         if (after == p) { ok.store(false); return; }
         out[r * n_cols + c] = (float)v;
         p = after;
-        while (p < end && *p != delim && *p != '\n') ++p;
+        // only whitespace may follow the number inside a field ('1.5abc'
+        // must fail, matching the Python fallback's float() ValueError)
+        while (p < end && *p != delim && *p != '\n') {
+          if (*p != ' ' && *p != '\t' && *p != '\r') { ok.store(false); return; }
+          ++p;
+        }
         if (c + 1 < n_cols) {
           if (p >= end || *p != delim) { ok.store(false); return; }
           ++p;

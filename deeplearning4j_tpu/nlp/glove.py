@@ -1,21 +1,28 @@
 """GloVe: co-occurrence counting + weighted-least-squares embedding fit.
 
 Reference: ``models/glove/Glove.java``, ``models/glove/AbstractCoOccurrences
-.java`` (streaming window-weighted co-occurrence counts; 1/d weighting),
+.java`` (streaming window-weighted co-occurrence counts spilled through
+binary round/shadow buffers; 1/d weighting),
 ``models/embeddings/learning/impl/elements/GloVe.java`` (per-pair AdaGrad
 update, xMax=100, alpha=0.75).
 
-TPU redesign: co-occurrence counting is a host-side dict pass (the spill-file
-machinery of the reference is an out-of-core detail, not a capability); the
-optimisation loop ships shuffled (row, col, Xij) batches to the jitted
+TPU redesign: counting accumulates in a host dict up to a pair budget, then
+spills sorted (key=row*V+col, weight) runs to disk;
+``SpillingCoOccurrences`` external-merges the runs (heap merge, duplicates
+summed) and streams chunks — so the co-occurrence table is never required
+to fit in RAM, the capability the reference's shadow-copy buffers provide.
+The optimisation loop ships shuffled (row, col, Xij) batches to the jitted
 ``glove_step`` kernel (``nlp/learning.py``) — AdaGrad scatter updates on
 device.
 """
 
 from __future__ import annotations
 
+import heapq
+import os
+import tempfile
 from collections import defaultdict
-from typing import Dict, Iterable, Optional, Tuple
+from typing import Dict, Iterable, Iterator, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -44,20 +51,27 @@ class CoOccurrences:
         self.symmetric = symmetric
         self.counts: Dict[Tuple[int, int], float] = defaultdict(float)
 
+    def _count_sentence(self, tokens: list) -> None:
+        idx = [self.vocab.index_of(t) for t in tokens]
+        idx = [i for i in idx if i >= 0]
+        n = len(idx)
+        for i in range(n):
+            for d in range(1, self.window + 1):
+                j = i + d
+                if j >= n:
+                    break
+                w = 1.0 / d
+                self.counts[(idx[i], idx[j])] += w
+                if self.symmetric:
+                    self.counts[(idx[j], idx[i])] += w
+
+    def _after_sentence(self) -> None:
+        """Hook: SpillingCoOccurrences flushes here when over budget."""
+
     def fit_sentences(self, token_lists: Iterable[list]) -> "CoOccurrences":
         for tokens in token_lists:
-            idx = [self.vocab.index_of(t) for t in tokens]
-            idx = [i for i in idx if i >= 0]
-            n = len(idx)
-            for i in range(n):
-                for d in range(1, self.window + 1):
-                    j = i + d
-                    if j >= n:
-                        break
-                    w = 1.0 / d
-                    self.counts[(idx[i], idx[j])] += w
-                    if self.symmetric:
-                        self.counts[(idx[j], idx[i])] += w
+            self._count_sentence(tokens)
+            self._after_sentence()
         return self
 
     def as_arrays(self):
@@ -71,6 +85,117 @@ class CoOccurrences:
         return rows, cols, vals
 
 
+class SpillingCoOccurrences(CoOccurrences):
+    """Out-of-core co-occurrence counting (≙ ``AbstractCoOccurrences.java``'s
+    binary spill files with shadow-copy round buffers, re-derived as sorted
+    spill runs + external heap merge).
+
+    Counts accumulate in the in-RAM dict until ``memory_pairs`` distinct
+    pairs, then the dict is flushed as a sorted (uint64 key = row*V+col,
+    float32 weight) run file.  ``stream_chunks`` heap-merges all runs plus
+    the live dict, summing duplicate keys, and yields (rows, cols, vals)
+    chunks — the full table never needs to fit in memory.
+    """
+
+    def __init__(self, vocab: VocabCache, window: int = 15,
+                 symmetric: bool = True, memory_pairs: int = 2_000_000,
+                 tmp_dir: Optional[str] = None):
+        super().__init__(vocab, window, symmetric)
+        self.memory_pairs = max(1, memory_pairs)
+        self._tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="glove_cooc_")
+        self._spills = []          # file paths of sorted runs
+        self.n_spills = 0
+
+    def _flush(self):
+        if not self.counts:
+            return
+        V = len(self.vocab)
+        keys = np.fromiter(
+            (r * V + c for (r, c) in self.counts), np.uint64,
+            count=len(self.counts))
+        vals = np.fromiter(self.counts.values(), np.float32,
+                           count=len(self.counts))
+        order = np.argsort(keys, kind="stable")
+        base = os.path.join(self._tmp_dir, f"run{self.n_spills:05d}")
+        # raw .npy so merge can mmap and read block-wise (npz would force a
+        # whole-run load, defeating the out-of-core point)
+        np.save(base + ".keys.npy", keys[order])
+        np.save(base + ".vals.npy", vals[order])
+        self._spills.append(base)
+        self.n_spills += 1
+        self.counts.clear()
+
+    def _after_sentence(self) -> None:
+        if len(self.counts) >= self.memory_pairs:
+            self._flush()
+
+    @staticmethod
+    def _iter_run(base: str, block: int = 1 << 16):
+        """Stream one sorted run from disk in bounded blocks (mmap-backed;
+        RAM is O(block), never O(run))."""
+        keys = np.load(base + ".keys.npy", mmap_mode="r")
+        vals = np.load(base + ".vals.npy", mmap_mode="r")
+        for i in range(0, len(keys), block):
+            yield from zip(keys[i:i + block].tolist(),
+                           vals[i:i + block].tolist())
+
+    def _run_streams(self) -> list:
+        streams = [self._iter_run(base) for base in self._spills]
+        if self.counts:
+            V = len(self.vocab)
+            items = sorted((r * V + c, v) for (r, c), v in self.counts.items())
+            streams.append(iter(items))
+        return streams
+
+    def stream_chunks(self, chunk_size: int = 1 << 20
+                      ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Merged unique (rows, cols, vals) in key order, in bounded chunks."""
+        V = len(self.vocab)
+        merged = heapq.merge(*self._run_streams())
+        keys, vals = [], []
+        cur_key, cur_val = None, 0.0
+        for k, v in merged:
+            if k == cur_key:
+                cur_val += v
+                continue
+            if cur_key is not None:
+                keys.append(cur_key)
+                vals.append(cur_val)
+                if len(keys) >= chunk_size:
+                    ka = np.asarray(keys, np.uint64)
+                    yield ((ka // V).astype(np.int32),
+                           (ka % V).astype(np.int32),
+                           np.asarray(vals, np.float32))
+                    keys, vals = [], []
+            cur_key, cur_val = k, v
+        if cur_key is not None:
+            keys.append(cur_key)
+            vals.append(cur_val)
+        if keys:
+            ka = np.asarray(keys, np.uint64)
+            yield ((ka // V).astype(np.int32), (ka % V).astype(np.int32),
+                   np.asarray(vals, np.float32))
+
+    def as_arrays(self):
+        """Materialise the merged table (compat path; spills permitting)."""
+        parts = list(self.stream_chunks())
+        if not parts:
+            return (np.empty(0, np.int32), np.empty(0, np.int32),
+                    np.empty(0, np.float32))
+        return (np.concatenate([p[0] for p in parts]),
+                np.concatenate([p[1] for p in parts]),
+                np.concatenate([p[2] for p in parts]))
+
+    def close(self):
+        for base in self._spills:
+            for suffix in (".keys.npy", ".vals.npy"):
+                try:
+                    os.unlink(base + suffix)
+                except OSError:
+                    pass
+        self._spills = []
+
+
 class Glove(WordVectors):
     def __init__(self, config=None, sentence_iterator: SentenceIterator = None,
                  tokenizer_factory: TokenizerFactory = None,
@@ -78,7 +203,7 @@ class Glove(WordVectors):
                  learning_rate: float = 0.05, x_max: float = 100.0,
                  alpha: float = 0.75, min_word_frequency: int = 1,
                  batch_size: int = 1024, seed: int = 12345,
-                 symmetric: bool = True):
+                 symmetric: bool = True, memory_pairs: Optional[int] = None):
         self.sentence_iterator = sentence_iterator
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
         self.layer_size = layer_size
@@ -91,6 +216,7 @@ class Glove(WordVectors):
         self.batch_size = batch_size
         self.seed = seed
         self.symmetric = symmetric
+        self.memory_pairs = memory_pairs  # spill budget; None = in-RAM
         self.vocab: Optional[VocabCache] = None
         self.lookup: Optional[InMemoryLookupTable] = None
         self.cum_loss = 0.0
@@ -107,6 +233,29 @@ class Glove(WordVectors):
     # seam for the distributed variant (DistributedGlove shards this)
     _glove_step = staticmethod(learning.glove_step)
 
+    def _train_pairs(self, state, rows, cols, vals, rs):
+        """One pass over a (rows, cols, vals) block in shuffled fixed-size
+        batches through the jitted AdaGrad kernel."""
+        (w, wc, b, bc, hw, hwc, hb, hbc) = state
+        n = len(rows)
+        B = self.batch_size
+        perm = rs.permutation(n)
+        for i0 in range(0, n, B):
+            sel = perm[i0:i0 + B]
+            pad = B - len(sel)
+            mask = np.concatenate([np.ones(len(sel), np.float32),
+                                   np.zeros(pad, np.float32)])
+            r = np.concatenate([rows[sel], np.zeros(pad, np.int32)])
+            c = np.concatenate([cols[sel], np.zeros(pad, np.int32)])
+            x = np.concatenate([vals[sel], np.ones(pad, np.float32)])
+            (w, wc, b, bc, hw, hwc, hb, hbc, loss) = self._glove_step(
+                w, wc, b, bc, hw, hwc, hb, hbc,
+                jnp.asarray(r), jnp.asarray(c), jnp.asarray(x),
+                jnp.asarray(mask), jnp.float32(self.learning_rate),
+                jnp.float32(self.x_max), jnp.float32(self.alpha))
+            self.cum_loss += float(loss)
+        return (w, wc, b, bc, hw, hwc, hb, hbc)
+
     def fit(self) -> "Glove":
         # vocab
         def seqs():
@@ -119,9 +268,13 @@ class Glove(WordVectors):
         self.vocab = VocabConstructor(
             min_element_frequency=self.min_word_frequency).build_vocab(seqs())
         V, D = len(self.vocab), self.layer_size
-        cooc = CoOccurrences(self.vocab, self.window, self.symmetric)
+        if self.memory_pairs:
+            cooc = SpillingCoOccurrences(self.vocab, self.window,
+                                         self.symmetric,
+                                         memory_pairs=self.memory_pairs)
+        else:
+            cooc = CoOccurrences(self.vocab, self.window, self.symmetric)
         cooc.fit_sentences(self._token_lists())
-        rows, cols, vals = cooc.as_arrays()
 
         rs = np.random.RandomState(self.seed)
         w = jnp.asarray((rs.rand(V, D).astype(np.float32) - 0.5) / D)
@@ -132,25 +285,23 @@ class Glove(WordVectors):
         hwc = jnp.ones((V, D), jnp.float32)
         hb = jnp.ones((V,), jnp.float32)
         hbc = jnp.ones((V,), jnp.float32)
+        state = (w, wc, b, bc, hw, hwc, hb, hbc)
 
-        n = len(rows)
-        B = self.batch_size
-        for _ in range(self.epochs):
-            perm = rs.permutation(n)
-            for i0 in range(0, n, B):
-                sel = perm[i0:i0 + B]
-                pad = B - len(sel)
-                mask = np.concatenate([np.ones(len(sel), np.float32),
-                                       np.zeros(pad, np.float32)])
-                r = np.concatenate([rows[sel], np.zeros(pad, np.int32)])
-                c = np.concatenate([cols[sel], np.zeros(pad, np.int32)])
-                x = np.concatenate([vals[sel], np.ones(pad, np.float32)])
-                (w, wc, b, bc, hw, hwc, hb, hbc, loss) = self._glove_step(
-                    w, wc, b, bc, hw, hwc, hb, hbc,
-                    jnp.asarray(r), jnp.asarray(c), jnp.asarray(x),
-                    jnp.asarray(mask), jnp.float32(self.learning_rate),
-                    jnp.float32(self.x_max), jnp.float32(self.alpha))
-                self.cum_loss += float(loss)
+        spilled = isinstance(cooc, SpillingCoOccurrences) and cooc.n_spills
+        if spilled:
+            # out-of-core: each epoch streams merged chunks; shuffling is
+            # within-chunk (the reference's round-buffer pass has the same
+            # locality), so RAM stays bounded by chunk_size
+            for _ in range(self.epochs):
+                for rows, cols, vals in cooc.stream_chunks():
+                    state = self._train_pairs(state, rows, cols, vals, rs)
+        else:
+            rows, cols, vals = cooc.as_arrays()
+            for _ in range(self.epochs):
+                state = self._train_pairs(state, rows, cols, vals, rs)
+        (w, wc, b, bc, hw, hwc, hb, hbc) = state
+        if isinstance(cooc, SpillingCoOccurrences):
+            cooc.close()
 
         # final vectors: w + w̃ (standard GloVe practice)
         self.lookup = InMemoryLookupTable(self.vocab, D, seed=self.seed,
@@ -209,6 +360,12 @@ class Glove(WordVectors):
 
         def seed(self, s):
             self._kw["seed"] = s
+            return self
+
+        def max_memory_pairs(self, n):
+            """Spill-to-disk budget: at most n distinct co-occurrence pairs
+            held in RAM (reference maxMemory on AbstractCoOccurrences)."""
+            self._kw["memory_pairs"] = n
             return self
 
         def symmetric(self, b):

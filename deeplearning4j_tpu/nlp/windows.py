@@ -29,8 +29,9 @@ class Window:
 
 def windows(tokens: List[str], window_size: int = 5) -> List[Window]:
     """One Window per token, edge-padded so every window has exactly
-    ``window_size`` words (odd sizes center the focus word; even sizes put
-    it left of center, matching the reference's floor division)."""
+    ``window_size`` words.  Odd sizes center the focus word; even sizes put
+    it RIGHT of center (focus index ``window_size // 2``: e.g. size 4 gives
+    2 words before, 1 after)."""
     if window_size < 1:
         raise ValueError("window_size must be >= 1")
     half = window_size // 2

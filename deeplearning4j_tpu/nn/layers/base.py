@@ -61,6 +61,8 @@ class Layer:
     weight_init: str = "xavier"
     dist: Optional[dict] = None        # distribution spec when weight_init="distribution"
     dropout: float = 0.0               # input dropout probability (reference dropOut)
+    drop_connect: bool = False         # dropOut masks WEIGHTS instead of inputs
+    _SUPPORTS_DROP_CONNECT = False     # overridden by layers that mask W
     l1: float = 0.0
     l2: float = 0.0
     learning_rate: Optional[float] = None   # per-layer lr override
@@ -74,6 +76,13 @@ class Layer:
 
         activations.get(self.activation)
         initializers.check(self.weight_init)
+        if self.drop_connect and not self._SUPPORTS_DROP_CONNECT:
+            # fail fast: with drop_connect set, input dropout is disabled,
+            # so a layer that never masks W would silently lose ALL dropout
+            raise ValueError(
+                f"{type(self).__name__} does not support drop_connect "
+                "(weight masking is implemented for Dense/Output layers); "
+                "use plain dropout here")
 
     # ---- shape plumbing -------------------------------------------------
     def setup(self, input_type: InputType) -> "Layer":
@@ -106,15 +115,30 @@ class Layer:
         raise NotImplementedError
 
     def maybe_dropout(self, x, *, train, rng):
-        """Input dropout (reference ``util/Dropout.java:24-36`` applyDropout:
-        inverted dropout scaling at train time)."""
-        if not train or self.dropout <= 0.0:
+        """Input dropout (reference ``util/Dropout.java`` applyDropout:
+        inverted dropout scaling at train time).  With ``drop_connect`` the
+        dropOut probability applies to weights instead (reference
+        ``useDropConnect``), so input dropout is a no-op here."""
+        if not train or self.dropout <= 0.0 or self.drop_connect:
             return x
         if rng is None:
             raise ValueError(f"Layer {self.name}: dropout requires an rng key at train time")
         keep = 1.0 - self.dropout
         mask = jax.random.bernoulli(rng, keep, x.shape)
         return jnp.where(mask, x / keep, 0.0)
+
+    def maybe_drop_connect(self, W, *, train, rng):
+        """DropConnect: bernoulli-mask the weight matrix at train time
+        (reference ``util/Dropout.java:24-36`` applyDropConnect, with
+        inverted scaling so inference needs no rescale)."""
+        if not train or not self.drop_connect or self.dropout <= 0.0:
+            return W
+        if rng is None:
+            raise ValueError(
+                f"Layer {self.name}: drop_connect requires an rng key at train time")
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, W.shape)
+        return jnp.where(mask, W / keep, 0.0)
 
     # ---- regularization -------------------------------------------------
     def reg_score(self, params: Dict[str, jax.Array]) -> jax.Array:

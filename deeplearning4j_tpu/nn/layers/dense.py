@@ -24,6 +24,7 @@ from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 class DenseLayer(Layer):
     n_in: Optional[int] = None
     n_out: Optional[int] = None
+    _SUPPORTS_DROP_CONNECT = True  # apply() masks W via maybe_drop_connect
 
     def setup(self, input_type: InputType) -> "DenseLayer":
         if self.n_in is None:
@@ -49,7 +50,8 @@ class DenseLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
-        z = x @ params["W"] + params["b"]
+        w = self.maybe_drop_connect(params["W"], train=train, rng=rng)
+        z = x @ w + params["b"]
         return activations.get(self.activation)(z), state
 
     def pre_output(self, params, x):

@@ -62,6 +62,25 @@ class BatchNormalization(Layer):
         # (reference needed two separate code paths, BatchNormalization.java:116)
         axes = tuple(range(x.ndim - 1))
         if train:
+            # helper fast path (≙ cudnnBatchNormalizationForwardTraining):
+            # fused mean/var/normalize in one VMEM pass, fused backward VJP
+            from deeplearning4j_tpu import helpers as _h
+
+            helper = _h.get_helper("batch_norm")
+            if (helper is not None and hasattr(helper, "apply_training")
+                    and helper.supports(x) and x.ndim == 2):
+                gamma = (jnp.full((self.n_out,), self.gamma, x.dtype)
+                         if self.lock_gamma_beta else params["gamma"])
+                beta = (jnp.full((self.n_out,), self.beta, x.dtype)
+                        if self.lock_gamma_beta else params["beta"])
+                y, mean, var = helper.apply_training(x, gamma, beta, self.eps)
+                new_state = {
+                    "mean": self.decay * state["mean"]
+                            + (1 - self.decay) * jax.lax.stop_gradient(mean),
+                    "var": self.decay * state["var"]
+                           + (1 - self.decay) * jax.lax.stop_gradient(var),
+                }
+                return activations.get(self.activation)(y), new_state
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
             new_state = {

@@ -102,8 +102,10 @@ class ProfilerListener(IterationListener):
         if iteration >= self.start and not self._active and iteration < self.end:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
-        elif iteration >= self.end and self._active:
-            # block so the captured window contains finished device work
+        if self._active and iteration + 1 >= self.end:
+            # stop on the LAST in-window iteration (not the first one past
+            # it) so the trace flushes even when training ends exactly at
+            # the window; block so it contains finished device work
             jax.block_until_ready(model.params)
             jax.profiler.stop_trace()
             self._active = False

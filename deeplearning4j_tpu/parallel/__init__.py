@@ -19,3 +19,7 @@ from deeplearning4j_tpu.parallel.pipeline import (
     PipelineParallelTrainingMaster,
     split_stages,
 )
+from deeplearning4j_tpu.parallel.checkpoint import (
+    save_checkpoint,
+    restore_checkpoint,
+)

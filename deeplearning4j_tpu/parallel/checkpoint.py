@@ -1,0 +1,222 @@
+"""Sharded checkpointing — per-host shard files for mesh-sharded training.
+
+Reference scale-up analog: ``util/ModelSerializer.java:32-95`` writes one
+zip from one JVM; a TPU pod slice cannot funnel params through one host, so
+here every process writes ONLY its addressable shards to its own
+``shards-<process>.npz`` plus a JSON manifest recording, per leaf, the
+global shape/dtype, the ``PartitionSpec``, and the global slices each saved
+shard covers.  Restore reassembles each leaf from whatever shard files are
+visible on (shared) storage and ``device_put``s it with the original
+NamedSharding reconstructed over the caller's mesh — so a checkpoint taken
+on one mesh restores onto any mesh with the same axis names.
+
+Resumability: ``iteration`` and the facade's KeyStream root key are saved,
+so a restored run replays the exact key sequence the uninterrupted run
+would have used (resume-equivalence is the test oracle,
+``tests/test_checkpoint_sharded.py``).
+
+Single-file portability (``ModelSerializer`` parity) stays in
+``models/serialization.py``; this module is the multi-chip/multi-host path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+MANIFEST = "manifest-{proc}.json"
+SHARDS = "shards-{proc}.npz"
+META = "checkpoint.json"
+
+
+# --------------------------------------------------------------- tree <-> flat
+def _flatten(tree, prefix=""):
+    """Flatten nested dicts to {path: leaf}; path segments joined by '/'."""
+    out = {}
+    for k in sorted(tree):
+        v = tree[k]
+        p = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, p + "/"))
+        else:
+            out[p] = v
+    return out
+
+
+def _unflatten(flat: Dict[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for path, v in flat.items():
+        cur = out
+        keys = path.split("/")
+        for k in keys[:-1]:
+            cur = cur.setdefault(k, {})
+        cur[keys[-1]] = v
+    return out
+
+
+def _spec_to_json(spec) -> list:
+    out = []
+    for e in tuple(spec):
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append(list(e))
+        else:
+            out.append(str(e))
+    return out
+
+
+def _spec_from_json(entries) -> PartitionSpec:
+    parts = []
+    for e in entries:
+        if isinstance(e, list):
+            parts.append(tuple(e))
+        else:
+            parts.append(e)
+    return PartitionSpec(*parts)
+
+
+def _leaf_spec(leaf) -> list:
+    sh = getattr(leaf, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return _spec_to_json(sh.spec)
+    return []  # replicated / single-device / host array
+
+
+# ------------------------------------------------------------------------ save
+def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = None) -> None:
+    """Write this process's shards of the facade's params / updater state /
+    net state (or explicit ``trees``) plus iteration + RNG root key."""
+    os.makedirs(directory, exist_ok=True)
+    proc = jax.process_index()
+    trees = trees if trees is not None else {
+        "params": net.params,
+        "updater_state": net.updater_state,
+        "net_state": net.net_state,
+    }
+    manifest: Dict[str, Any] = {"leaves": {}}
+    arrays: Dict[str, np.ndarray] = {}
+    for tname, tree in trees.items():
+        for path, leaf in _flatten(tree, f"{tname}/").items():
+            leaf = jnp.asarray(leaf)
+            entry = {
+                "shape": list(leaf.shape),
+                "dtype": str(leaf.dtype),
+                "spec": _leaf_spec(leaf),
+                "shards": [],
+            }
+            if hasattr(leaf, "addressable_shards") and leaf.addressable_shards:
+                seen = set()
+                for shard in leaf.addressable_shards:
+                    idx = tuple(
+                        (0 if s.start is None else int(s.start),
+                         dim if s.stop is None else int(s.stop))
+                        for s, dim in zip(shard.index, leaf.shape))
+                    if idx in seen:  # replicated copies: store once
+                        continue
+                    seen.add(idx)
+                    key = f"{path}@{len(entry['shards'])}"
+                    arrays[key] = np.asarray(shard.data)
+                    entry["shards"].append({"key": key, "index": [list(i) for i in idx]})
+            else:
+                key = f"{path}@0"
+                arrays[key] = np.asarray(leaf)
+                entry["shards"].append({
+                    "key": key,
+                    "index": [[0, d] for d in leaf.shape]})
+            manifest["leaves"][path] = entry
+    with open(os.path.join(directory, MANIFEST.format(proc=proc)), "w") as f:
+        json.dump(manifest, f)
+    with open(os.path.join(directory, SHARDS.format(proc=proc)), "wb") as f:
+        np.savez(f, **arrays)
+    if proc == 0:
+        meta = {
+            "format_version": 1,
+            "iteration": int(getattr(net, "iteration", 0)),
+            "processes": jax.process_count(),
+        }
+        keys = getattr(net, "_keys", None)
+        if keys is not None:
+            meta["rng_key"] = np.asarray(
+                jax.random.key_data(keys._key)).tolist()
+        with open(os.path.join(directory, META), "w") as f:
+            json.dump(meta, f)
+
+
+# --------------------------------------------------------------------- restore
+def _assemble(entry, shard_files) -> np.ndarray:
+    shape = tuple(entry["shape"])
+    dtype = np.dtype(entry["dtype"])
+    out = np.zeros(shape, dtype)
+    if not shape:  # scalar
+        for npz in shard_files:
+            for s in entry["shards"]:
+                if s["key"] in npz:
+                    return npz[s["key"]].astype(dtype)
+    filled = np.zeros(shape, bool)
+    for npz in shard_files:
+        for s in entry["shards"]:
+            if s["key"] not in npz:
+                continue
+            sl = tuple(slice(a, b) for a, b in s["index"])
+            out[sl] = npz[s["key"]]
+            filled[sl] = True
+    if not bool(filled.all()):
+        raise ValueError(
+            f"checkpoint incomplete: leaf {entry} missing shard data "
+            f"(multi-host checkpoint restored without shared storage?)")
+    return out
+
+
+def restore_checkpoint(directory: str, net=None, *, mesh: Optional[Mesh] = None
+                       ) -> Tuple[Dict[str, Any], Dict[str, Any], Dict[str, Any], int]:
+    """Reassemble (params, updater_state, net_state, iteration).  With
+    ``net`` given, restores in place (incl. iteration + RNG stream).  With
+    ``mesh`` given, leaves are placed with their saved PartitionSpec over
+    that mesh; otherwise they come back as host-backed arrays."""
+    manifests = []
+    shard_files = []
+    for fn in sorted(os.listdir(directory)):
+        if fn.startswith("manifest-"):
+            with open(os.path.join(directory, fn)) as f:
+                manifests.append(json.load(f))
+        elif fn.startswith("shards-"):
+            shard_files.append(np.load(os.path.join(directory, fn)))
+    if not manifests:
+        raise FileNotFoundError(f"no checkpoint manifests in {directory}")
+    leaves: Dict[str, Any] = {}
+    for man in manifests:
+        for path, entry in man["leaves"].items():
+            if path in leaves:
+                continue
+            arr = _assemble(entry, shard_files)
+            if mesh is not None:
+                spec = _spec_from_json(entry["spec"])
+                arr = jax.device_put(arr, NamedSharding(mesh, spec))
+            else:
+                arr = jnp.asarray(arr)
+            leaves[path] = arr
+    for npz in shard_files:
+        npz.close()
+    full = _unflatten(leaves)
+    params = full.get("params", {})
+    upd = full.get("updater_state", {})
+    ns = full.get("net_state", {})
+    with open(os.path.join(directory, META)) as f:
+        meta = json.load(f)
+    iteration = int(meta.get("iteration", 0))
+    if net is not None:
+        net.params = params
+        net.updater_state = upd
+        net.net_state = ns
+        net.iteration = iteration
+        if "rng_key" in meta and getattr(net, "_keys", None) is not None:
+            net._keys._key = jax.random.wrap_key_data(
+                jnp.asarray(np.asarray(meta["rng_key"], np.uint32)))
+    return params, upd, ns, iteration

@@ -25,6 +25,8 @@ frequencies reproduce the reference's looser local-SGD semantics bit-for-bit
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Any, Dict, Optional
 
 import jax
@@ -38,6 +40,73 @@ from deeplearning4j_tpu.optimize import updaters as upd
 
 def _stack_tree(tree, k: int):
     return jax.tree_util.tree_map(lambda a: jnp.broadcast_to(a[None], (k,) + a.shape), tree)
+
+
+_SENTINEL = object()
+
+
+class _WindowAssembler:
+    """Background window assembly: a producer thread groups minibatches into
+    stacked [F, K, B, ...] host arrays so padding/stacking overlaps device
+    execution of the previous window (the native-ETL principle applied to
+    the DP hot path; producer errors re-raise on the consumer side; an
+    abandoned consumer unblocks the producer via the stop event)."""
+
+    def __init__(self, iterator, K: int, F: int, stack_fn, prefetch: int = 2):
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+
+        def put(item) -> bool:
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def run():
+            try:
+                window = []
+                for ds in iterator:
+                    window.append(ds)
+                    if len(window) == K * F:
+                        if not put(stack_fn(window)):
+                            return
+                        window = []
+                if window and not self._stop.is_set():
+                    while len(window) % K:
+                        window.append(window[-1])  # duplicate to fill replicas
+                    put(stack_fn(window))
+            except BaseException as e:
+                self._error = e
+            finally:
+                put(_SENTINEL)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        try:  # unblock a producer waiting on a full queue
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._queue.get()
+                if item is _SENTINEL:
+                    if self._error is not None:
+                        err, self._error = self._error, None
+                        raise RuntimeError("window assembly failed") from err
+                    return
+                yield item
+        finally:
+            self.close()
 
 
 class ParallelWrapper:
@@ -132,11 +201,17 @@ class ParallelWrapper:
     def fit(self, iterator):
         """Train over an iterator of DataSets.  Each averaging window
         consumes ``workers * averaging_frequency`` minibatches (reference
-        split sizing ``ParameterAveragingTrainingMaster.java:315-321``)."""
-        from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
+        split sizing ``ParameterAveragingTrainingMaster.java:315-321``).
 
-        if isinstance(iterator, DataSetIterator) and iterator.async_supported():
-            iterator = AsyncDataSetIterator(iterator, self.prefetch_size)
+        Window assembly never runs on the dispatch thread: in-memory
+        unmasked data goes through the native C++ slab pipeline
+        (``native.Batcher`` producing whole [F*K*B] windows in one gather);
+        everything else is stacked by the ``_WindowAssembler`` prefetch
+        thread."""
+        from deeplearning4j_tpu.datasets.iterator import (
+            AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
+        )
+
         if self._step_fn is None:
             self._build()
 
@@ -150,25 +225,26 @@ class ParallelWrapper:
         upd_k = jax.device_put(upd_k, shard) if net.updater_state else upd_k
         ns_k = jax.device_put(ns_k, shard) if net.net_state else ns_k
 
+        if (isinstance(iterator, ListDataSetIterator)
+                and iterator._data.features_mask is None
+                and iterator._data.labels_mask is None):
+            windows = self._native_windows(iterator)
+        else:
+            if isinstance(iterator, DataSetIterator) and iterator.async_supported():
+                iterator = AsyncDataSetIterator(iterator, self.prefetch_size)
+            windows = _WindowAssembler(iterator, K, F, self._stack_window,
+                                       prefetch=self.prefetch_size)
+
         it = net.iteration
-        window: list = []
         last_losses = None
-        for ds in iterator:
-            window.append(ds)
-            if len(window) == K * F:
-                params_k, upd_k, ns_k, last_losses = self._run_window(
-                    params_k, upd_k, ns_k, window, it
-                )
-                it += len(window) // K
-                window = []
-        # leftover minibatches train on a truncated window (pad replicas)
-        if window:
-            while len(window) % K:
-                window.append(window[-1])  # duplicate to fill replicas
-            params_k, upd_k, ns_k, last_losses = self._run_window(
-                params_k, upd_k, ns_k, window, it
+        for xs, ys, fms, lms, n_batches in windows:
+            rngs = jax.random.split(self.net._keys.next(),
+                                    xs.shape[0] * K).reshape(xs.shape[0], K)
+            params_k, upd_k, ns_k, last_losses = self._step_fn(
+                params_k, upd_k, ns_k, jnp.asarray(float(it)),
+                jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
             )
-            it += len(window) // K
+            it += n_batches // K
 
         # fold averaged replica-0 state back into the facade
         net.params = jax.tree_util.tree_map(lambda a: a[0], params_k)
@@ -180,7 +256,9 @@ class ParallelWrapper:
         net.iteration = it
         return net
 
-    def _run_window(self, params_k, upd_k, ns_k, window, iteration):
+    def _stack_window(self, window):
+        """Host half of a window step: pad + stack to [F, K, B, ...].
+        Runs on the assembler thread, not the dispatch thread."""
         K = self.workers
         F = len(window) // K
         # equalize batch sizes across the window (short/ragged final batches)
@@ -190,11 +268,56 @@ class ParallelWrapper:
         ys = np.stack([np.stack([w.labels for w in window[f * K : (f + 1) * K]]) for f in range(F)])
         fms = self._stack_masks([w.features_mask for w in window], K, F)
         lms = self._stack_masks([w.labels_mask for w in window], K, F)
-        rngs = jax.random.split(self.net._keys.next(), F * K).reshape(F, K)
-        return self._step_fn(
-            params_k, upd_k, ns_k, jnp.asarray(float(iteration)),
-            jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
-        )
+        return xs, ys, fms, lms, len(window)
+
+    def _native_windows(self, iterator):
+        """Whole windows as single native gathers: the C++ producer thread
+        assembles a contiguous [F*K*B] slab per window (row-major order
+        matches the reference's sequential minibatch grouping).  The ragged
+        tail honors the iterator's drop_last and is emitted as a TRUNCATED
+        window — only as many (K-padded) batch rows as the data fills, with
+        a labels mask on the zero-padded remainder — so iteration counts and
+        score semantics track the generic path."""
+        from deeplearning4j_tpu import native
+
+        K, F = self.workers, self.averaging_frequency
+        B = iterator.batch()
+        data = iterator._data
+        n = len(data)
+        if getattr(iterator, "_drop_last", False):
+            n = (n // B) * B  # generic path drops the ragged final batch
+            if n == 0:
+                return
+            data = data.subset(slice(0, n))
+        slab = B * K * F
+        batcher = native.Batcher(data.features, data.labels, slab,
+                                 shuffle=False, seed=1, drop_last=False,
+                                 queue_cap=max(1, self.prefetch_size))
+        try:
+            while True:
+                out = batcher.next()
+                if out is None:
+                    return
+                feat, lab, n_valid = out
+                if n_valid == slab:
+                    xs = feat.reshape((F, K, B) + feat.shape[1:])
+                    ys = lab.reshape((F, K, B) + lab.shape[1:])
+                    yield xs, ys, None, None, F * K
+                    continue
+                # tail: keep only the batches the data actually fills,
+                # rounded up to a multiple of K replicas
+                nb = -(-n_valid // B)          # ceil: batches with any data
+                L = -(-nb // K) * K            # pad batch count to K
+                rows = L * B
+                xs = feat[:rows].reshape((L // K, K, B) + feat.shape[1:])
+                ys = lab[:rows].reshape((L // K, K, B) + lab.shape[1:])
+                shape = ((rows,) if ys.ndim == 4 else (rows, ys.shape[3]))
+                m = np.zeros(shape, np.float32)
+                m[:n_valid] = 1.0
+                lms = jnp.asarray(m.reshape((L // K, K, B) + m.shape[1:]))
+                yield xs, ys, None, lms, L
+        finally:
+            batcher.close()
 
     @staticmethod
     def _stack_masks(masks, K, F):

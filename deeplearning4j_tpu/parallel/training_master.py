@@ -48,6 +48,50 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 
+class PhaseStats:
+    """Phase-timed distributed training stats (≙ ``CommonSparkTrainingStats
+    .java`` / ``ParameterAveragingTrainingMasterStats.java``: the reference
+    times count/split/repartition/mapPartitions/aggregate per fit; the
+    TPU-native phases are the analogous pipeline sections)."""
+
+    def __init__(self):
+        self.steps = 0
+        self.phase_ms: Dict[str, list] = {}
+
+    class _Timer:
+        def __init__(self, stats, name):
+            self._stats, self._name = stats, name
+
+        def __enter__(self):
+            import time
+
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            import time
+
+            self._stats.phase_ms.setdefault(self._name, []).append(
+                (time.perf_counter() - self._t0) * 1e3)
+            return False
+
+    def phase(self, name: str) -> "PhaseStats._Timer":
+        return PhaseStats._Timer(self, name)
+
+    def as_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"steps": self.steps, "phases": {}}
+        for name, ms in self.phase_ms.items():
+            arr = np.asarray(ms)
+            out["phases"][name] = {
+                "count": len(ms),
+                "total_ms": round(float(arr.sum()), 3),
+                "mean_ms": round(float(arr.mean()), 3),
+                "min_ms": round(float(arr.min()), 3),
+                "max_ms": round(float(arr.max()), 3),
+            }
+        return out
+
+
 class TrainingMaster:
     """Strategy SPI (reference ``TrainingMaster.java:27``)."""
 
@@ -73,6 +117,7 @@ class SyncTrainingMaster(TrainingMaster):
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
         self._stats: Dict[str, Any] = {"steps": 0, "step_time_ms": []}
+        self._phases = PhaseStats()
         self._step = None
 
     def _param_layout(self, net):
@@ -141,32 +186,47 @@ class SyncTrainingMaster(TrainingMaster):
         upd_state = jax.device_put(net.updater_state, self._upd_layout)
         ns = jax.device_put(net.net_state, self._repl_sharding)
         K = self.mesh.shape[backend.AXIS_DATA]
-        for ds in iterator:
+        it = iter(iterator)
+        while True:
+            # phases ≙ CommonSparkTrainingStats: fetch (split/repartition),
+            # place (broadcast), dispatch (mapPartitions fit; the gradient
+            # all-reduce — the reference's aggregate — is inside the program)
+            with self._phases.phase("fetch"):
+                try:
+                    ds = next(it)
+                except StopIteration:
+                    break
             if len(ds) % K:
                 ds = ds.pad_batch(((len(ds) + K - 1) // K) * K)
             t0 = time.perf_counter()
-            x = jax.device_put(jnp.asarray(ds.features), self._data_sharding)
-            y = jax.device_put(jnp.asarray(ds.labels), self._data_sharding)
-            fm = None if ds.features_mask is None else jax.device_put(
-                jnp.asarray(ds.features_mask), self._data_sharding)
-            lm = None if ds.labels_mask is None else jax.device_put(
-                jnp.asarray(ds.labels_mask), self._data_sharding)
-            params, upd_state, ns, loss = self._step(
-                params, upd_state, ns, jnp.asarray(float(net.iteration)),
-                x, y, net._keys.next(), fm, lm,
-            )
+            with self._phases.phase("place"):
+                x = jax.device_put(jnp.asarray(ds.features), self._data_sharding)
+                y = jax.device_put(jnp.asarray(ds.labels), self._data_sharding)
+                fm = None if ds.features_mask is None else jax.device_put(
+                    jnp.asarray(ds.features_mask), self._data_sharding)
+                lm = None if ds.labels_mask is None else jax.device_put(
+                    jnp.asarray(ds.labels_mask), self._data_sharding)
+            with self._phases.phase("dispatch"):
+                params, upd_state, ns, loss = self._step(
+                    params, upd_state, ns, jnp.asarray(float(net.iteration)),
+                    x, y, net._keys.next(), fm, lm,
+                )
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             if self.collect_stats:
-                jax.block_until_ready(loss)
+                with self._phases.phase("device_sync"):
+                    jax.block_until_ready(loss)
                 self._stats["step_time_ms"].append((time.perf_counter() - t0) * 1e3)
             self._stats["steps"] += 1
+            self._phases.steps += 1
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration)
         net.params, net.updater_state, net.net_state = params, upd_state, ns
 
     def training_stats(self):
-        return dict(self._stats)
+        out = dict(self._stats)
+        out.update(self._phases.as_dict())
+        return out
 
 
 class ParameterAveragingTrainingMaster(TrainingMaster):
@@ -191,6 +251,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
         self._stats: Dict[str, Any] = {"windows": 0}
+        self._phases = PhaseStats()
 
     def execute_training(self, net, iterator):
         from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
@@ -203,11 +264,15 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             average_updaters=self.average_updaters,
             mesh=self.mesh,
         )
-        pw.fit(iterator)
+        with self._phases.phase("fit"):
+            pw.fit(iterator)
         self._stats["windows"] += 1
+        self._phases.steps += pw.iteration  # accumulate across epochs
 
     def training_stats(self):
-        return dict(self._stats)
+        out = dict(self._stats)
+        out.update(self._phases.as_dict())
+        return out
 
 
 class DistributedNetwork:

@@ -142,13 +142,25 @@ def bootstrap_distributed(coordinator: Optional[str] = None,
     process_id = process_id if process_id is not None else (
         int(os.environ["DL4J_TPU_PROC_ID"])
         if "DL4J_TPU_PROC_ID" in os.environ else None)
-    if coordinator is None and num_processes is None:
+    explicit = [coordinator, num_processes, process_id]
+    if any(v is not None for v in explicit):
+        # any of the triple signals explicit-init intent; an incomplete
+        # triple is a config error, not a silent single-process no-op
+        if any(v is None for v in explicit):
+            missing = [n for n, v in zip(
+                ("coordinator", "num_processes", "process_id"), explicit)
+                if v is None]
+            raise ValueError(
+                "explicit distributed init needs coordinator, num_processes "
+                f"AND process_id; missing: {missing} (set the "
+                "DL4J_TPU_COORDINATOR/DL4J_TPU_NUM_PROCS/DL4J_TPU_PROC_ID "
+                "env vars or pass them)")
+        initialize_distributed(coordinator, num_processes, process_id)
+    else:
         if not _on_tpu_pod():
             return {"distributed": False, "processes": 1, "process_id": 0}
         # pod metadata carries coordinator/count/index; jax discovers them
         initialize_distributed()
-    else:
-        initialize_distributed(coordinator, num_processes, process_id)
     return {"distributed": True,
             "processes": jax.process_count(),
             "process_id": jax.process_index()}

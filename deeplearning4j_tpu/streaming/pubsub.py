@@ -107,6 +107,7 @@ class MessageBroker:
                 if not path.startswith("/poll/"):
                     self.send_error(404)
                     return
+                purge()  # GET-only clients must also trigger idle cleanup
                 topic = path[len("/poll/"):]
                 params = dict(p.split("=", 1) for p in query.split("&")
                               if "=" in p)

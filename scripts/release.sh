@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Release packaging (≙ the reference's maven release scripting, minimized):
+# build an sdist+wheel from setup.py/pyproject into dist/ after a green run.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VERSION="${1:?usage: scripts/release.sh <version>}"
+
+scripts/runtests.sh cpu
+python - <<PY
+import re, pathlib
+p = pathlib.Path("deeplearning4j_tpu/__init__.py")
+src = p.read_text()
+if re.search(r'^__version__', src, flags=re.M):
+    src = re.sub(r'^__version__ = .*$', f'__version__ = "${VERSION}"', src, flags=re.M)
+else:
+    src = f'__version__ = "${VERSION}"\n' + src
+p.write_text(src)
+print("version ->", "${VERSION}")
+PY
+python -m pip wheel --no-deps -w dist . 2>/dev/null || \
+  python setup.py sdist 2>/dev/null || \
+  echo "NOTE: no packaging backend in this image; version stamped only"
+echo "release ${VERSION} prepared"

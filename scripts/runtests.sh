@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Test runner (≙ reference /runtests.sh:33 — the repo-root test entry).
+#
+#   scripts/runtests.sh            # CPU tier: full suite on the 8-device
+#                                  # virtual mesh (no TPU needed)
+#   scripts/runtests.sh tpu        # real-chip tier: pytest -m tpu
+#   scripts/runtests.sh bench      # bench.py (one JSON line)
+#   scripts/runtests.sh dryrun     # multichip sharding dryrun (8 virtual)
+#   scripts/runtests.sh all        # everything above in order
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+tier="${1:-cpu}"
+
+run_cpu()    { python -m pytest tests/ -q; }
+run_tpu()    { DL4J_TPU_TESTS=1 python -m pytest tests/ -m tpu -q; }
+run_bench()  { python bench.py; }
+run_dryrun() { python -c 'from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)'; }
+
+case "$tier" in
+  cpu)    run_cpu ;;
+  tpu)    run_tpu ;;
+  bench)  run_bench ;;
+  dryrun) run_dryrun ;;
+  all)    run_cpu; run_dryrun; run_tpu; run_bench ;;
+  *) echo "usage: $0 [cpu|tpu|bench|dryrun|all]" >&2; exit 2 ;;
+esac

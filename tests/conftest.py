@@ -3,6 +3,15 @@ without TPU hardware (the reference's Spark local[N] pattern — SURVEY.md §4:
 'multi-node is simulated ... correctness of distribution is proven by
 equivalence to local sequential math').
 
+Two tiers:
+
+- default: everything runs on the virtual CPU mesh; tests marked ``tpu``
+  are skipped.
+- ``DL4J_TPU_TESTS=1 python -m pytest -m tpu``: the real-device tier — the
+  platform is left alone (real TPU via the tunnel), only ``tpu``-marked
+  tests are meant to run (compiled non-interpret Pallas kernels, donation,
+  bf16, one real SyncTrainingMaster step).
+
 Note: jax may already be imported by the interpreter's sitecustomize (TPU
 tunnel registration), so platform selection must go through
 ``jax.config.update`` (still effective pre-backend-init), not env vars.
@@ -10,20 +19,49 @@ tunnel registration), so platform selection must go through
 
 import os
 
-# Read by the CPU client at first backend init (lazy), so setting it here
-# works even if jax itself is already imported.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+import pytest
 
-import jax
+TPU_MODE = os.environ.get("DL4J_TPU_TESTS") == "1"
 
-jax.config.update("jax_platforms", "cpu")
-# float64 available for gradient-check precision (tests opt in per-array)
-jax.config.update("jax_enable_x64", True)
+if not TPU_MODE:
+    # Read by the CPU client at first backend init (lazy), so setting it here
+    # works even if jax itself is already imported.
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    # float64 available for gradient-check precision (tests opt in per-array)
+    jax.config.update("jax_enable_x64", True)
+else:
+    import jax  # real platform; no x64 (TPUs have no native f64)
 
 import numpy as np
-import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: must run on a real TPU chip "
+        "(DL4J_TPU_TESTS=1 python -m pytest -m tpu)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if TPU_MODE:
+        skip = pytest.mark.skip(
+            reason="CPU-tier test skipped in real-TPU mode (run without "
+                   "DL4J_TPU_TESTS for the full suite)")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+    else:
+        skip = pytest.mark.skip(reason="requires a real TPU "
+                                       "(DL4J_TPU_TESTS=1 -m tpu)")
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip)
 
 
 @pytest.fixture

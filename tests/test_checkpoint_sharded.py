@@ -1,0 +1,132 @@
+"""Sharded checkpoint/resume: per-host shard files, any-mesh restore,
+resume-equivalence (train A→B straight == train A, checkpoint, restore,
+train B).  Reference analog: ModelSerializer.java:32-95 scaled to a mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.parallel import (
+    DistributedNetwork, SyncTrainingMaster, restore_checkpoint,
+    save_checkpoint,
+)
+
+
+def _net(seed=21):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(seed)
+         .updater("adam", learning_rate=0.05).list()
+         .layer(DenseLayer(n_in=8, n_out=16, activation="relu"))
+         .layer(OutputLayer(n_in=16, n_out=4)).build())
+    ).init()
+
+
+def _batches(rs, n=64):
+    x = rs.rand(n, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+    return x, y
+
+
+def test_roundtrip_plain(tmp_path):
+    net = _net()
+    rs = np.random.RandomState(0)
+    x, y = _batches(rs)
+    net.fit(x, y)
+    save_checkpoint(str(tmp_path), net)
+    net2 = _net(seed=99)
+    restore_checkpoint(str(tmp_path), net2)
+    assert net2.iteration == net.iteration
+    assert np.allclose(net.params_to_vector(), net2.params_to_vector())
+    xq = rs.rand(4, 8).astype(np.float32)
+    assert np.allclose(np.asarray(net.output(xq)), np.asarray(net2.output(xq)))
+
+
+def test_dp_train_checkpoint_resume_equivalence(tmp_path):
+    """The verdict's oracle: DP-train -> checkpoint -> restore -> continue
+    must equal uninterrupted DP training (params AND updater state AND the
+    RNG stream survive)."""
+    rs = np.random.RandomState(1)
+    x, y = _batches(rs, 64)
+    mesh = backend.default_mesh()
+
+    # uninterrupted: 4 batches
+    ref = _net()
+    DistributedNetwork(ref, SyncTrainingMaster(mesh=mesh)).fit(
+        ListDataSetIterator(DataSet(x, y), 16))
+
+    # interrupted after 2 batches
+    a = _net()
+    DistributedNetwork(a, SyncTrainingMaster(mesh=mesh)).fit(
+        ListDataSetIterator(DataSet(x[:32], y[:32]), 16))
+    save_checkpoint(str(tmp_path), a)
+
+    b = _net(seed=1234)  # fresh facade, wrong seed — restore must fix it
+    restore_checkpoint(str(tmp_path), b, mesh=mesh)
+    assert b.iteration == 2
+    DistributedNetwork(b, SyncTrainingMaster(mesh=mesh)).fit(
+        ListDataSetIterator(DataSet(x[32:], y[32:]), 16))
+
+    np.testing.assert_allclose(ref.params_to_vector(), b.params_to_vector(),
+                               atol=1e-6)
+
+
+def test_sharded_leaves_saved_per_shard(tmp_path):
+    """Mesh-sharded leaves are written as genuine shards (no host gather of
+    the global array) and restore onto a mesh with the saved spec."""
+    mesh = backend.default_mesh()
+    axis = backend.AXIS_DATA
+    n_dev = mesh.shape[axis]
+    arr = jax.device_put(
+        np.arange(n_dev * 4 * 3, dtype=np.float32).reshape(n_dev * 4, 3),
+        NamedSharding(mesh, P(axis)))
+
+    class Fake:
+        params = {"layer_0": {"W": arr}}
+        updater_state = {}
+        net_state = {}
+        iteration = 7
+        _keys = None
+
+    save_checkpoint(str(tmp_path), Fake())
+    import json, os
+    man = json.load(open(os.path.join(tmp_path, "manifest-0.json")))
+    entry = man["leaves"]["params/layer_0/W"]
+    assert len(entry["shards"]) == n_dev          # one piece per device
+    assert entry["spec"] == [axis]
+    params, _, _, it = restore_checkpoint(str(tmp_path), mesh=mesh)
+    got = params["layer_0"]["W"]
+    assert got.sharding.spec == P(axis)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(arr))
+    assert it == 7
+
+
+def test_replicated_leaves_stored_once(tmp_path):
+    mesh = backend.default_mesh()
+    arr = jax.device_put(np.ones((4, 4), np.float32),
+                         NamedSharding(mesh, P()))
+
+    class Fake:
+        params = {"l": {"W": arr}}
+        updater_state = {}
+        net_state = {}
+        iteration = 0
+        _keys = None
+
+    save_checkpoint(str(tmp_path), Fake())
+    import json, os
+    man = json.load(open(os.path.join(tmp_path, "manifest-0.json")))
+    assert len(man["leaves"]["params/l/W"]["shards"]) == 1
+
+
+def test_restore_missing_dir_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path / "nope"))

@@ -1,0 +1,138 @@
+"""Out-of-core GloVe co-occurrence (spill runs + external merge) and the
+embedding-quality metric (wordsNearest cluster purity — the text8-class
+sanity check runnable without network egress).
+
+Reference: ``models/glove/AbstractCoOccurrences.java`` (binary spill files,
+shadow-copy round buffers) — capability parity: corpora whose co-occurrence
+table exceeds the pair budget still train, with identical counts.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import Glove
+from deeplearning4j_tpu.nlp.glove import CoOccurrences, SpillingCoOccurrences
+from deeplearning4j_tpu.nlp.vocab import (
+    Sequence, VocabConstructor, VocabWord,
+)
+
+
+def synthetic_corpus(n=400, seed=7):
+    rs = np.random.RandomState(seed)
+    weather = ["rain", "snow", "storm", "cloud", "wind", "sun"]
+    finance = ["bank", "money", "stock", "market", "trade", "price"]
+    out = []
+    for i in range(n):
+        topic = weather if i % 2 == 0 else finance
+        out.append(" ".join(rs.choice(topic, size=10)))
+    return out
+
+
+def _vocab(corpus):
+    def seqs():
+        for s in corpus:
+            seq = Sequence()
+            for t in s.split():
+                seq.add_element(VocabWord(label=t))
+            yield seq
+
+    return VocabConstructor(min_element_frequency=1).build_vocab(seqs())
+
+
+def _tokens(corpus):
+    return [s.split() for s in corpus]
+
+
+def test_spilling_counts_match_in_ram():
+    corpus = synthetic_corpus(200)
+    vocab = _vocab(corpus)
+    ram = CoOccurrences(vocab, window=4).fit_sentences(_tokens(corpus))
+    spill = SpillingCoOccurrences(vocab, window=4, memory_pairs=16)
+    spill.fit_sentences(_tokens(corpus))
+    assert spill.n_spills > 1, "budget of 16 pairs must force spills"
+
+    r1, c1, v1 = ram.as_arrays()
+    order = np.argsort(r1.astype(np.int64) * len(vocab) + c1)
+    r2, c2, v2 = spill.as_arrays()  # merged output is key-sorted
+    np.testing.assert_array_equal(r1[order], r2)
+    np.testing.assert_array_equal(c1[order], c2)
+    np.testing.assert_allclose(v1[order], v2, rtol=1e-5)
+    spill.close()
+
+
+def test_spilling_stream_chunks_bounded():
+    corpus = synthetic_corpus(100)
+    vocab = _vocab(corpus)
+    spill = SpillingCoOccurrences(vocab, window=3, memory_pairs=8)
+    spill.fit_sentences(_tokens(corpus))
+    chunks = list(spill.stream_chunks(chunk_size=10))
+    assert all(len(r) <= 10 for r, _, _ in chunks[:-1])
+    # keys unique across the whole stream
+    all_keys = np.concatenate(
+        [r.astype(np.int64) * len(vocab) + c for r, c, _ in chunks])
+    assert len(np.unique(all_keys)) == len(all_keys)
+    spill.close()
+
+
+def test_glove_trains_out_of_core():
+    glove = (Glove.Builder()
+             .iterate(synthetic_corpus(400))
+             .layer_size(24)
+             .window_size(4)
+             .epochs(25)
+             .learning_rate(0.1)
+             .min_word_frequency(2)
+             .seed(3)
+             .max_memory_pairs(16)   # tiny budget: forces the spill path
+             .build())
+    glove.fit()
+    weather = ["rain", "snow", "storm"]
+    finance = ["bank", "money", "stock"]
+    within = np.mean([glove.similarity(a, b)
+                      for a in weather for b in weather if a != b])
+    across = np.mean([glove.similarity(a, b)
+                      for a in weather for b in finance])
+    assert within > across + 0.1, f"within={within:.3f} across={across:.3f}"
+
+
+def _cluster_purity(model, clusters, top_n=3):
+    """wordsNearest quality: fraction of top-n neighbours that stay within
+    the query word's topic cluster."""
+    hits = total = 0
+    for cluster in clusters:
+        others = set(cluster)
+        for w in cluster:
+            for n in model.words_nearest([w], top_n=top_n):
+                total += 1
+                hits += n in others
+    return hits / max(1, total)
+
+
+def test_embedding_quality_metric(tmp_path):
+    """The committed quality number: wordsNearest cluster purity for
+    Word2Vec and GloVe on the hermetic two-topic corpus (text8-class
+    protocol; the image has no network egress for the real text8)."""
+    from deeplearning4j_tpu.nlp import Word2Vec
+
+    corpus = synthetic_corpus(400)
+    weather = ["rain", "snow", "storm", "cloud", "wind", "sun"]
+    finance = ["bank", "money", "stock", "market", "trade", "price"]
+
+    w2v = (Word2Vec.Builder().iterate(corpus).layer_size(24).window_size(4)
+           .epochs(8).min_word_frequency(2).seed(5).build())
+    w2v.fit()
+    glove = (Glove.Builder().iterate(corpus).layer_size(24).window_size(4)
+             .epochs(25).learning_rate(0.1).min_word_frequency(2).seed(3)
+             .max_memory_pairs(64).build())
+    glove.fit()
+
+    report = {
+        "protocol": "wordsNearest top-3 cluster purity, 2-topic corpus",
+        "word2vec_purity": round(_cluster_purity(w2v, [weather, finance]), 3),
+        "glove_purity": round(_cluster_purity(glove, [weather, finance]), 3),
+    }
+    (tmp_path / "quality.json").write_text(json.dumps(report))
+    assert report["word2vec_purity"] > 0.8, report
+    assert report["glove_purity"] > 0.8, report

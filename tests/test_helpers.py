@@ -132,6 +132,77 @@ def test_bn_layer_inference_uses_helper_and_matches_fallback():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_bn_training_fused_matches_plain():
+    """Fused training-mode kernel (≙ cudnnBatchNormalizationForwardTraining):
+    forward moments + output parity vs the stock jnp path."""
+    from deeplearning4j_tpu.helpers import pallas_ops
+
+    rs = np.random.RandomState(5)
+    x = jnp.asarray(rs.randn(12, 7).astype(np.float32))
+    gamma = jnp.asarray(rs.randn(7).astype(np.float32))
+    beta = jnp.asarray(rs.randn(7).astype(np.float32))
+    y, mean, var = pallas_ops.bn_training(x, gamma, beta, 1e-5)
+    m = x.mean(0)
+    v = x.var(0)
+    want = gamma * (x - m) * jax.lax.rsqrt(v + 1e-5) + beta
+    np.testing.assert_allclose(np.asarray(mean), np.asarray(m), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), np.asarray(v), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+def test_bn_training_fused_gradient_parity():
+    """Fused backward VJP vs jax.grad of the stock formula, all of
+    (dx, dgamma, dbeta)."""
+    from deeplearning4j_tpu.helpers import pallas_ops
+
+    rs = np.random.RandomState(6)
+    x = jnp.asarray(rs.randn(10, 5).astype(np.float32))
+    gamma = jnp.asarray(rs.randn(5).astype(np.float32))
+    beta = jnp.asarray(rs.randn(5).astype(np.float32))
+    w = jnp.asarray(rs.randn(10, 5).astype(np.float32))  # loss weights
+
+    def fused(x, g, b):
+        y, _, _ = pallas_ops.bn_training(x, g, b, 1e-5)
+        return jnp.sum(y * w)
+
+    def plain(x, g, b):
+        m, v = x.mean(0), x.var(0)
+        return jnp.sum((g * (x - m) * jax.lax.rsqrt(v + 1e-5) + b) * w)
+
+    got = jax.grad(fused, argnums=(0, 1, 2))(x, gamma, beta)
+    want = jax.grad(plain, argnums=(0, 1, 2))(x, gamma, beta)
+    for a, b_, name in zip(got, want, ("dx", "dgamma", "dbeta")):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-3, atol=1e-5, err_msg=name)
+
+
+def test_bn_layer_training_helper_vs_fallback_parity():
+    """BN layer train-mode forward + grads: helper on == helper off."""
+    rs = np.random.RandomState(7)
+    layer = BatchNormalization(n_out=6, name="bn")
+    params = layer.init(jax.random.PRNGKey(1))
+    state = layer.init_state()
+    x = jnp.asarray(rs.randn(16, 6).astype(np.float32))
+
+    def loss(params, on):
+        helpers.enable_helpers(on)
+        try:
+            y, ns = layer.apply(params, state, x, train=True)
+            return jnp.sum(y ** 2), ns
+        finally:
+            helpers.enable_helpers(True)
+
+    (l_fast, ns_fast), g_fast = jax.value_and_grad(loss, has_aux=True)(params, True)
+    (l_plain, ns_plain), g_plain = jax.value_and_grad(loss, has_aux=True)(params, False)
+    np.testing.assert_allclose(float(l_fast), float(l_plain), rtol=1e-4)
+    for k in g_fast:
+        np.testing.assert_allclose(np.asarray(g_fast[k]), np.asarray(g_plain[k]),
+                                   rtol=1e-3, atol=1e-5, err_msg=k)
+    for k in ns_fast:
+        np.testing.assert_allclose(np.asarray(ns_fast[k]), np.asarray(ns_plain[k]),
+                                   rtol=1e-4, atol=1e-6, err_msg=k)
+
+
 def test_lrn_layer_helper_vs_fallback_parity():
     rs = np.random.RandomState(5)
     layer = LocalResponseNormalization()
