@@ -132,6 +132,66 @@ def resnet50(height: int = 224, width: int = 224, channels: int = 3,
     return ComputationGraph(conf).init()
 
 
+def alexnet(height: int = 224, width: int = 224, channels: int = 3,
+            n_classes: int = 1000, seed: int = 12345,
+            updater: str = "nesterovs", lr: float = 0.01,
+            compute_dtype: Optional[str] = None) -> MultiLayerNetwork:
+    """AlexNet (the classic DL4J model-zoo config: 5 conv + LRN + 3 fc with
+    dropout).  Exercises LRN (the Pallas helper path) at benchmark scale."""
+    from deeplearning4j_tpu.nn.layers import LocalResponseNormalization
+
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater, learning_rate=lr)
+         .regularization(True).l2(5e-4).list())
+    if compute_dtype:
+        b.compute_dtype(compute_dtype)
+    (b.layer(ConvolutionLayer(n_out=96, kernel_size=(11, 11), stride=(4, 4),
+                              activation="relu", weight_init="relu"))
+      .layer(LocalResponseNormalization())
+      .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+      .layer(ConvolutionLayer(n_out=256, kernel_size=(5, 5), stride=(1, 1),
+                              padding=(2, 2), activation="relu"))
+      .layer(LocalResponseNormalization())
+      .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+      .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), stride=(1, 1),
+                              padding=(1, 1), activation="relu"))
+      .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), stride=(1, 1),
+                              padding=(1, 1), activation="relu"))
+      .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), stride=(1, 1),
+                              padding=(1, 1), activation="relu"))
+      .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+      .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+      .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+      .layer(OutputLayer(n_out=n_classes, loss="mcxent", activation="softmax"))
+      .set_input_type(InputType.convolutional(height, width, channels)))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def vgg16(height: int = 224, width: int = 224, channels: int = 3,
+          n_classes: int = 1000, seed: int = 12345,
+          updater: str = "nesterovs", lr: float = 0.01,
+          compute_dtype: Optional[str] = None) -> MultiLayerNetwork:
+    """VGG-16 (13 conv 3x3 + 3 fc; DL4J model-zoo config)."""
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater, learning_rate=lr)
+         .regularization(True).l2(5e-4).list())
+    if compute_dtype:
+        b.compute_dtype(compute_dtype)
+    for block, (n_convs, ch) in enumerate([(2, 64), (2, 128), (3, 256),
+                                           (3, 512), (3, 512)]):
+        for _ in range(n_convs):
+            b.layer(ConvolutionLayer(n_out=ch, kernel_size=(3, 3),
+                                     stride=(1, 1), padding=(1, 1),
+                                     activation="relu"))
+        b.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2),
+                                 stride=(2, 2)))
+    (b.layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+      .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
+      .layer(OutputLayer(n_out=n_classes, loss="mcxent", activation="softmax"))
+      .set_input_type(InputType.convolutional(height, width, channels)))
+    return MultiLayerNetwork(b.build()).init()
+
+
 def graves_lstm_char_lm(vocab_size: int = 77, hidden: int = 200,
                         seq_len: int = 64, layers: int = 2,
                         seed: int = 12345, updater: str = "rmsprop",

@@ -102,6 +102,7 @@ class SpillingCoOccurrences(CoOccurrences):
                  tmp_dir: Optional[str] = None):
         super().__init__(vocab, window, symmetric)
         self.memory_pairs = max(1, memory_pairs)
+        self._owns_tmp = tmp_dir is None
         self._tmp_dir = tmp_dir or tempfile.mkdtemp(prefix="glove_cooc_")
         self._spills = []          # file paths of sorted runs
         self.n_spills = 0
@@ -194,6 +195,12 @@ class SpillingCoOccurrences(CoOccurrences):
                 except OSError:
                     pass
         self._spills = []
+        if self._owns_tmp:
+            try:
+                os.rmdir(self._tmp_dir)
+            except OSError:
+                pass  # non-empty (foreign files) or already gone
+            self._owns_tmp = False
 
 
 class Glove(WordVectors):
@@ -287,21 +294,23 @@ class Glove(WordVectors):
         hbc = jnp.ones((V,), jnp.float32)
         state = (w, wc, b, bc, hw, hwc, hb, hbc)
 
-        spilled = isinstance(cooc, SpillingCoOccurrences) and cooc.n_spills
-        if spilled:
-            # out-of-core: each epoch streams merged chunks; shuffling is
-            # within-chunk (the reference's round-buffer pass has the same
-            # locality), so RAM stays bounded by chunk_size
-            for _ in range(self.epochs):
-                for rows, cols, vals in cooc.stream_chunks():
+        try:
+            spilled = isinstance(cooc, SpillingCoOccurrences) and cooc.n_spills
+            if spilled:
+                # out-of-core: each epoch streams merged chunks; shuffling is
+                # within-chunk (the reference's round-buffer pass has the same
+                # locality), so RAM stays bounded by chunk_size
+                for _ in range(self.epochs):
+                    for rows, cols, vals in cooc.stream_chunks():
+                        state = self._train_pairs(state, rows, cols, vals, rs)
+            else:
+                rows, cols, vals = cooc.as_arrays()
+                for _ in range(self.epochs):
                     state = self._train_pairs(state, rows, cols, vals, rs)
-        else:
-            rows, cols, vals = cooc.as_arrays()
-            for _ in range(self.epochs):
-                state = self._train_pairs(state, rows, cols, vals, rs)
+        finally:  # spill files must not outlive a failed fit
+            if isinstance(cooc, SpillingCoOccurrences):
+                cooc.close()
         (w, wc, b, bc, hw, hwc, hb, hbc) = state
-        if isinstance(cooc, SpillingCoOccurrences):
-            cooc.close()
 
         # final vectors: w + w̃ (standard GloVe practice)
         self.lookup = InMemoryLookupTable(self.vocab, D, seed=self.seed,

@@ -97,15 +97,22 @@ class ProfilerListener(IterationListener):
         self._active = False
 
     def iteration_done(self, model, iteration):
+        """The trace window covers the DISPATCH of iterations
+        [start, start+duration): it opens in the iteration_done callback
+        preceding step `start` and closes in the one following step
+        ``end - 1`` — exactly ``duration`` captured steps.  An atexit hook
+        flushes the trace if training ends inside the window."""
         import jax
 
-        if iteration >= self.start and not self._active and iteration < self.end:
+        nxt = iteration + 1  # the next step that will be dispatched
+        if not self._active and self.start <= nxt < self.end:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
-        if self._active and iteration + 1 >= self.end:
-            # stop on the LAST in-window iteration (not the first one past
-            # it) so the trace flushes even when training ends exactly at
-            # the window; block so it contains finished device work
+            import atexit
+
+            atexit.register(self.stop)
+        if self._active and nxt >= self.end:
+            # block so the captured window contains finished device work
             jax.block_until_ready(model.params)
             jax.profiler.stop_trace()
             self._active = False

@@ -121,11 +121,14 @@ def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = No
                     if idx in seen:  # replicated copies: store once
                         continue
                     seen.add(idx)
-                    key = f"{path}@{len(entry['shards'])}"
+                    # process-qualified key: every host writes its own npz,
+                    # and restore merges ALL manifests, so keys must be
+                    # globally unique across processes
+                    key = f"p{proc}/{path}@{len(entry['shards'])}"
                     arrays[key] = np.asarray(shard.data)
                     entry["shards"].append({"key": key, "index": [list(i) for i in idx]})
             else:
-                key = f"{path}@0"
+                key = f"p{proc}/{path}@0"
                 arrays[key] = np.asarray(leaf)
                 entry["shards"].append({
                     "key": key,
@@ -190,18 +193,28 @@ def restore_checkpoint(directory: str, net=None, *, mesh: Optional[Mesh] = None
             shard_files.append(np.load(os.path.join(directory, fn)))
     if not manifests:
         raise FileNotFoundError(f"no checkpoint manifests in {directory}")
-    leaves: Dict[str, Any] = {}
+    # merge per-process manifests: each host recorded only its own shards of
+    # a cross-host-sharded leaf, so a leaf's shard list is the UNION over
+    # all manifests (shape/dtype/spec agree by construction)
+    merged: Dict[str, Any] = {}
     for man in manifests:
         for path, entry in man["leaves"].items():
-            if path in leaves:
-                continue
-            arr = _assemble(entry, shard_files)
-            if mesh is not None:
-                spec = _spec_from_json(entry["spec"])
-                arr = jax.device_put(arr, NamedSharding(mesh, spec))
+            if path not in merged:
+                merged[path] = {k: entry[k] for k in ("shape", "dtype", "spec")}
+                merged[path]["shards"] = list(entry["shards"])
             else:
-                arr = jnp.asarray(arr)
-            leaves[path] = arr
+                have = {s["key"] for s in merged[path]["shards"]}
+                merged[path]["shards"] += [s for s in entry["shards"]
+                                           if s["key"] not in have]
+    leaves: Dict[str, Any] = {}
+    for path, entry in merged.items():
+        arr = _assemble(entry, shard_files)
+        if mesh is not None:
+            spec = _spec_from_json(entry["spec"])
+            arr = jax.device_put(arr, NamedSharding(mesh, spec))
+        else:
+            arr = jnp.asarray(arr)
+        leaves[path] = arr
     for npz in shard_files:
         npz.close()
     full = _unflatten(leaves)

@@ -54,9 +54,13 @@ class PhaseStats:
     times count/split/repartition/mapPartitions/aggregate per fit; the
     TPU-native phases are the analogous pipeline sections)."""
 
-    def __init__(self):
+    _NULL = None  # no-op timer singleton (enabled=False)
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
         self.steps = 0
-        self.phase_ms: Dict[str, list] = {}
+        # running aggregates only — O(1) memory however long training runs
+        self._agg: Dict[str, list] = {}  # name -> [count, total, min, max]
 
     class _Timer:
         def __init__(self, stats, name):
@@ -71,23 +75,40 @@ class PhaseStats:
         def __exit__(self, *exc):
             import time
 
-            self._stats.phase_ms.setdefault(self._name, []).append(
-                (time.perf_counter() - self._t0) * 1e3)
+            ms = (time.perf_counter() - self._t0) * 1e3
+            agg = self._stats._agg.get(self._name)
+            if agg is None:
+                self._stats._agg[self._name] = [1, ms, ms, ms]
+            else:
+                agg[0] += 1
+                agg[1] += ms
+                agg[2] = min(agg[2], ms)
+                agg[3] = max(agg[3], ms)
             return False
 
-    def phase(self, name: str) -> "PhaseStats._Timer":
+    class _NullTimer:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def phase(self, name: str):
+        if not self.enabled:
+            if PhaseStats._NULL is None:
+                PhaseStats._NULL = PhaseStats._NullTimer()
+            return PhaseStats._NULL
         return PhaseStats._Timer(self, name)
 
     def as_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"steps": self.steps, "phases": {}}
-        for name, ms in self.phase_ms.items():
-            arr = np.asarray(ms)
+        for name, (count, total, mn, mx) in self._agg.items():
             out["phases"][name] = {
-                "count": len(ms),
-                "total_ms": round(float(arr.sum()), 3),
-                "mean_ms": round(float(arr.mean()), 3),
-                "min_ms": round(float(arr.min()), 3),
-                "max_ms": round(float(arr.max()), 3),
+                "count": count,
+                "total_ms": round(total, 3),
+                "mean_ms": round(total / count, 3),
+                "min_ms": round(mn, 3),
+                "max_ms": round(mx, 3),
             }
         return out
 
@@ -117,7 +138,9 @@ class SyncTrainingMaster(TrainingMaster):
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
         self._stats: Dict[str, Any] = {"steps": 0, "step_time_ms": []}
-        self._phases = PhaseStats()
+        # per-step phase timers only when stats collection is requested —
+        # the default hot loop stays timer-free
+        self._phases = PhaseStats(enabled=collect_stats)
         self._step = None
 
     def _param_layout(self, net):

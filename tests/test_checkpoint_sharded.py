@@ -130,3 +130,26 @@ def test_replicated_leaves_stored_once(tmp_path):
 def test_restore_missing_dir_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         restore_checkpoint(str(tmp_path / "nope"))
+
+
+def test_multi_host_manifests_merge(tmp_path):
+    """A cross-host-sharded leaf: each process's manifest lists only its own
+    shards (process-qualified keys); restore must union them."""
+    import json, os
+
+    full = np.arange(8 * 2, dtype=np.float32).reshape(8, 2)
+    for proc, rows in ((0, (0, 4)), (1, (4, 8))):
+        man = {"leaves": {"params/l/W": {
+            "shape": [8, 2], "dtype": "float32", "spec": ["data"],
+            "shards": [{"key": f"p{proc}/params/l/W@0",
+                        "index": [[rows[0], rows[1]], [0, 2]]}],
+        }}}
+        with open(tmp_path / f"manifest-{proc}.json", "w") as f:
+            json.dump(man, f)
+        np.savez(tmp_path / f"shards-{proc}.npz",
+                 **{f"p{proc}/params/l/W@0": full[rows[0]:rows[1]]})
+    with open(tmp_path / "checkpoint.json", "w") as f:
+        json.dump({"format_version": 1, "iteration": 3, "processes": 2}, f)
+    params, _, _, it = restore_checkpoint(str(tmp_path))
+    assert it == 3
+    np.testing.assert_array_equal(np.asarray(params["l"]["W"]), full)
