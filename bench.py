@@ -362,9 +362,18 @@ def _cpu_fallback() -> int:
     env.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon registration entirely
     env["JAX_PLATFORMS"] = "cpu"
     env["DL4J_BENCH_NO_FALLBACK"] = "1"
-    proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                          env=env, timeout=3600)
-    return proc.returncode
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=3600)
+        return proc.returncode
+    except subprocess.TimeoutExpired:
+        # keep the one-JSON-line contract even if the CPU run crawls
+        print(json.dumps({
+            "metric": "bench error", "value": 0.0, "unit": "error",
+            "vs_baseline": 0.0,
+            "error": "cpu fallback exceeded 3600s",
+        }))
+        return 1
 
 
 if __name__ == "__main__":

@@ -620,6 +620,48 @@ class ComputationGraph(LazyScoreMixin):
         )
         return outs[0] if len(outs) == 1 else outs
 
+    def evaluate(self, iterator, evaluation=None):
+        """Classification metrics over a DataSet/MultiDataSet iterator
+        (reference ``ComputationGraph.doEvaluation`` — single-output graphs)."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        if len(self.conf.outputs) != 1:
+            raise ValueError("evaluate() supports single-output graphs; use "
+                             "output() + per-head Evaluation for multi-output")
+        ev = evaluation or Evaluation()
+        for batch in iterator:
+            if hasattr(batch, "features_masks"):  # MultiDataSet
+                x, y, fm, lm = self._unpack_multi(batch)
+                lm = None if lm is None else next(iter(lm.values()))
+                y = y[self.conf.outputs[0]]
+            else:
+                x, y = batch.features, batch.labels
+                fm, lm = batch.features_mask, batch.labels_mask
+            ev.eval(y, self.output(x, fmask=fm), mask=lm)
+        return ev
+
+    def feed_forward(self, inputs, train: bool = False, fmask=None):
+        """All vertex activations as a name->array dict (reference
+        ``ComputationGraph.feedForward()`` :1012-1036; output vertices carry
+        their post-activation values)."""
+        from deeplearning4j_tpu.nn import activations
+
+        inputs = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(inputs))
+        rng = self._keys.next() if train else None
+        acts, _, _ = self._forward(self.params, self.net_state, inputs,
+                                   train=train, rng=rng, fmask=fmask)
+        out = {}
+        out_names = set(self.conf.outputs)
+        for name, a in acts.items():
+            if name in out_names:
+                a = activations.get(self.nodes[name].layer.activation)(
+                    a.astype(jnp.float32) if self.conf.compute_dtype else a)
+            elif self.conf.compute_dtype is not None and hasattr(a, "dtype") \
+                    and jnp.issubdtype(a.dtype, jnp.floating):
+                a = a.astype(jnp.float32)  # fp32 API boundary
+            out[name] = a
+        return out
+
     def score(self, inputs=None, labels=None, dataset=None, fmask=None,
               lmask=None) -> float:
         if dataset is not None:

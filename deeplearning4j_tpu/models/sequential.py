@@ -329,6 +329,17 @@ class MultiLayerNetwork(LazyScoreMixin):
             acts = [a.astype(jnp.float32) for a in acts]  # fp32 API boundary
         return acts
 
+    def evaluate(self, iterator, evaluation=None):
+        """Run the iterator through ``output`` and accumulate classification
+        metrics (reference ``MultiLayerNetwork.evaluate(DataSetIterator)``)."""
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        ev = evaluation or Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features, fmask=ds.features_mask)
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
     def score(self, x=None, y=None, dataset=None, fmask=None, lmask=None) -> float:
         if dataset is not None:
             if hasattr(dataset, "features"):

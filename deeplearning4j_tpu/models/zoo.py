@@ -218,12 +218,16 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         n_heads: int = 4, layers: int = 2,
                         ff_mult: int = 4, seed: int = 12345,
                         updater: str = "adam", lr: float = 1e-3,
-                        seq_axis: Optional[str] = None) -> MultiLayerNetwork:
+                        seq_axis: Optional[str] = None,
+                        remat: bool = False) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
     mesh sequence axis (see ``parallel.sequence_parallel``): train
-    sequences sharded over chips without materializing full K/V."""
+    sequences sharded over chips without materializing full K/V.  With
+    ``remat=True`` each block rematerializes its activations in the
+    backward pass (jax.checkpoint) — the other half of the long-context
+    memory budget."""
     from deeplearning4j_tpu.nn.layers import (
         EmbeddingLayer, LayerNorm, ResidualBlock, SelfAttentionLayer,
     )
@@ -236,13 +240,13 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
     )
     b.layer(EmbeddingLayer(n_in=vocab_size, n_out=d_model))
     for i in range(layers):
-        b.layer(ResidualBlock(layers=(
+        b.layer(ResidualBlock(remat=remat, layers=(
             LayerNorm(n_in=d_model),
             SelfAttentionLayer(n_in=d_model, n_out=d_model,
                                n_heads=n_heads, causal=True,
                                seq_axis=seq_axis),
         )))
-        b.layer(ResidualBlock(layers=(
+        b.layer(ResidualBlock(remat=remat, layers=(
             LayerNorm(n_in=d_model),
             DenseLayer(n_in=d_model, n_out=d_model * ff_mult, activation="relu"),
             DenseLayer(n_in=d_model * ff_mult, n_out=d_model, activation="identity"),

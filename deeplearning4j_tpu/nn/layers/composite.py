@@ -25,9 +25,16 @@ from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict, register_l
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class ResidualBlock(Layer):
-    """y = x + f(x) where f = sublayers applied in order."""
+    """y = x + f(x) where f = sublayers applied in order.
+
+    ``remat=True`` wraps f in ``jax.checkpoint``: activations inside the
+    block are recomputed during the backward pass instead of stored —
+    the standard long-context memory trade (activation memory per block
+    drops from O(sublayers) to O(1) at ~1.3x FLOPs), composing with the
+    sequence-parallel path for sequences that would not otherwise fit HBM."""
 
     layers: Tuple[Layer, ...] = ()
+    remat: bool = False
 
     def setup(self, input_type: InputType) -> "ResidualBlock":
         done, it = [], input_type
@@ -59,15 +66,21 @@ class ResidualBlock(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         import inspect
 
-        h = x
         rngs = (jax.random.split(rng, len(self.layers))
                 if rng is not None else [None] * len(self.layers))
-        for i, sub in enumerate(self.layers):
-            kw = ({"mask": mask} if mask is not None
-                  and "mask" in inspect.signature(sub.apply).parameters else {})
-            h, _ = sub.apply(params.get(f"sub{i}", {}), {}, h,
-                             train=train, rng=rngs[i], **kw)
-        return x + h, state
+
+        def body(params, x, rngs, mask):
+            h = x
+            for i, sub in enumerate(self.layers):
+                kw = ({"mask": mask} if mask is not None
+                      and "mask" in inspect.signature(sub.apply).parameters else {})
+                h, _ = sub.apply(params.get(f"sub{i}", {}), {}, h,
+                                 train=train, rng=rngs[i], **kw)
+            return x + h
+
+        if self.remat and train:
+            body = jax.checkpoint(body)
+        return body(params, x, rngs, mask), state
 
     def reg_score(self, params):
         total = jnp.zeros(())
@@ -80,10 +93,11 @@ class ResidualBlock(Layer):
         return {
             "type": "ResidualBlock",
             "name": self.name,
+            "remat": self.remat,
             "layers": [sub.to_dict() for sub in self.layers],
         }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ResidualBlock":
-        return cls(name=d.get("name"),
+        return cls(name=d.get("name"), remat=d.get("remat", False),
                    layers=tuple(layer_from_dict(s) for s in d["layers"]))

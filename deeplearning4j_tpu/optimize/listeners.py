@@ -97,29 +97,38 @@ class ProfilerListener(IterationListener):
         self._active = False
 
     def iteration_done(self, model, iteration):
-        """The trace window covers the DISPATCH of iterations
-        [start, start+duration): it opens in the iteration_done callback
-        preceding step `start` and closes in the one following step
-        ``end - 1`` — exactly ``duration`` captured steps.  An atexit hook
-        flushes the trace if training ends inside the window."""
+        """Callback-driven capture: the trace opens at the iteration_done of
+        step ``start`` and closes at the iteration_done of step
+        ``start + duration``, recording the dispatch+execution of the
+        ``duration`` steps AFTER ``start`` (a callback listener cannot open
+        a trace before the very first step; use ``jax.profiler.trace``
+        directly to capture compile/warm-up).  If training ends inside the
+        window, the trace stays open until ``stop()`` — call it from the
+        training script — or, failing that, the atexit flush at process
+        exit."""
         import jax
 
-        nxt = iteration + 1  # the next step that will be dispatched
-        if not self._active and self.start <= nxt < self.end:
+        if not self._active and self.start <= iteration < self.end:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
+            self._model = model  # for the device sync in stop()
             import atexit
 
             atexit.register(self.stop)
-        if self._active and nxt >= self.end:
+        elif self._active and iteration >= self.end:
             # block so the captured window contains finished device work
             jax.block_until_ready(model.params)
             jax.profiler.stop_trace()
             self._active = False
+            self._model = None
 
     def stop(self):
         if self._active:
             import jax
 
+            model = getattr(self, "_model", None)
+            if model is not None:
+                jax.block_until_ready(model.params)
+                self._model = None
             jax.profiler.stop_trace()
             self._active = False

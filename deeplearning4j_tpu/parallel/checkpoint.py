@@ -120,6 +120,13 @@ def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = No
                         for s, dim in zip(shard.index, leaf.shape))
                     if idx in seen:  # replicated copies: store once
                         continue
+                    full = all(a == 0 and b == d
+                               for (a, b), d in zip(idx, leaf.shape))
+                    if full and proc != 0:
+                        # cross-host-replicated leaf: process 0's copy is
+                        # authoritative; storing N copies would grow a
+                        # pure-DP checkpoint N-fold
+                        continue
                     seen.add(idx)
                     # process-qualified key: every host writes its own npz,
                     # and restore merges ALL manifests, so keys must be

@@ -26,6 +26,7 @@ reference's distributed-vs-local pattern,
 
 from __future__ import annotations
 
+import collections
 import functools
 from typing import Any, Dict, Optional
 
@@ -163,7 +164,9 @@ class SequenceParallelTrainingMaster:
     def __init__(self, mesh: Optional[Mesh] = None, collect_stats: bool = False):
         self.mesh = mesh or backend.default_mesh()
         self.collect_stats = collect_stats
-        self._stats: Dict[str, Any] = {"steps": 0, "step_time_ms": []}
+        # bounded window (last 1024): O(1) memory over long runs
+        self._stats: Dict[str, Any] = {
+            "steps": 0, "step_time_ms": collections.deque(maxlen=1024)}
         self._step = None
 
     def _build(self, net):
@@ -251,4 +254,6 @@ class SequenceParallelTrainingMaster:
         net.params, net.updater_state, net.net_state = params, upd_state, ns
 
     def training_stats(self):
-        return dict(self._stats)
+        out = dict(self._stats)
+        out["step_time_ms"] = list(out["step_time_ms"])
+        return out

@@ -28,6 +28,7 @@ The ``TrainingMaster`` SPI is kept as the strategy seam, like the reference.
 
 from __future__ import annotations
 
+import collections
 from typing import Any, Dict, Iterable, Optional
 
 import jax
@@ -137,7 +138,10 @@ class SyncTrainingMaster(TrainingMaster):
         self.batch_size = batch_size
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
-        self._stats: Dict[str, Any] = {"steps": 0, "step_time_ms": []}
+        # step_time_ms is a bounded window (last 1024) — stats stay O(1)
+        # however long training runs; PhaseStats carries the full aggregates
+        self._stats: Dict[str, Any] = {
+            "steps": 0, "step_time_ms": collections.deque(maxlen=1024)}
         # per-step phase timers only when stats collection is requested —
         # the default hot loop stays timer-free
         self._phases = PhaseStats(enabled=collect_stats)
@@ -248,6 +252,7 @@ class SyncTrainingMaster(TrainingMaster):
 
     def training_stats(self):
         out = dict(self._stats)
+        out["step_time_ms"] = list(out["step_time_ms"])  # JSON-safe snapshot
         out.update(self._phases.as_dict())
         return out
 

@@ -236,6 +236,55 @@ def test_async_iterator_surfaces_producer_errors():
     assert len(batches) == 1
 
 
+def test_graph_feed_forward_activation_map():
+    """feedForward() returns every vertex's activations by name (reference
+    ComputationGraph.feedForward :1012-1036)."""
+    rs = np.random.RandomState(18)
+    net = _two_input_graph(seed=37)
+    xa, xb = rs.rand(4, 3).astype(np.float32), rs.rand(4, 2).astype(np.float32)
+    acts = net.feed_forward({"a": xa, "b": xb})
+    assert set(acts) >= {"a", "b", "da", "db", "m", "out"}
+    assert acts["da"].shape == (4, 8)
+    assert acts["m"].shape == (4, 16)
+    # output vertex carries post-activation (softmax) values
+    np.testing.assert_allclose(np.asarray(acts["out"]).sum(-1), 1.0, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(acts["out"]),
+        np.asarray(net.output({"a": xa, "b": xb})), atol=1e-6)
+
+
+def test_facade_evaluate_iterator():
+    """net.evaluate(iterator) parity on both facades (reference
+    MultiLayerNetwork.evaluate / ComputationGraph.doEvaluation)."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+
+    rs = np.random.RandomState(19)
+    x = rs.rand(64, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 2).astype(int)]
+    mln = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(41)
+         .updater("adam", learning_rate=0.05).list()
+         .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+         .layer(OutputLayer(n_in=16, n_out=2)).build())).init()
+    it = ListDataSetIterator(DataSet(x, y), 16)
+    for _ in range(40):
+        mln.fit(it)
+    ev = mln.evaluate(it)
+    assert ev.accuracy() > 0.85
+
+    # CG: multi-input via MultiDataSet iterator
+    rs2 = np.random.RandomState(20)
+    mds = _multi_data(rs2)
+    cg = _two_input_graph(seed=43)
+    mit = ListMultiDataSetIterator(mds, 16)
+    for _ in range(30):
+        cg.fit(mit)
+    ev2 = cg.evaluate(mit)
+    assert ev2.accuracy() > 0.85
+
+
 def test_multidataset_merge_and_shuffle():
     rs = np.random.RandomState(16)
     a, b = _multi_data(rs, 8), _multi_data(rs, 8)

@@ -100,6 +100,30 @@ def _char_batches(vocab, b, t, n, seed=0):
     return out
 
 
+def test_remat_block_equivalence():
+    """jax.checkpoint'd transformer blocks train identically to stored
+    activations (the long-context memory trade changes nothing numerically)."""
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 11, (4, 8))
+    x = ids.astype(np.float32)
+    y = np.eye(11, dtype=np.float32)[np.roll(ids, -1, 1)]
+    a = transformer_char_lm(vocab_size=11, d_model=16, n_heads=2, layers=1,
+                            seed=7, remat=False)
+    b = transformer_char_lm(vocab_size=11, d_model=16, n_heads=2, layers=1,
+                            seed=7, remat=True)
+    a.fit(x, y)
+    b.fit(x, y)
+    assert abs(a.score_value - b.score_value) < 1e-6
+    assert np.allclose(a.params_to_vector(), b.params_to_vector(), atol=1e-6)
+    # remat flag round-trips through config JSON
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    back = MultiLayerConfiguration.from_json(b.conf.to_json())
+    assert back.layers[1].remat is True
+
+
 def test_sequence_parallel_training_matches_single_device():
     """Transformer LM trained with (data=2, seq=4) sharding == the same
     model trained on one device — the TestCompareParameterAveraging...
