@@ -15,11 +15,14 @@ use ``InputType.convolutional_flat(32, 32, 3)`` for conv nets.
 
 from __future__ import annotations
 
+import logging
 import os
 from pathlib import Path
 from typing import Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
@@ -83,6 +86,11 @@ class CifarDataFetcher:
                 raise FileNotFoundError(
                     f"CIFAR-10 binaries not found under {root}; set "
                     "DL4J_TPU_CIFAR_DIR")
+            _log.warning(
+                "CIFAR-10 binaries not found under %s — using SYNTHETIC "
+                "class-colored patterns (is_synthetic=True). Point "
+                "DL4J_TPU_CIFAR_DIR at the real batches, or pass "
+                "allow_synthetic=False to fail instead.", root)
             n = num_examples or (2048 if train else 512)
             images, labels = _synthetic_cifar(n, seed if train else seed + 1)
         if num_examples is not None:

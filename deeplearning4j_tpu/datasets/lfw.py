@@ -13,11 +13,14 @@ class label).  No egress here, so:
 
 from __future__ import annotations
 
+import logging
 import os
 from pathlib import Path
 from typing import Optional, Tuple
 
 import numpy as np
+
+_log = logging.getLogger(__name__)
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
@@ -66,6 +69,10 @@ class LFWDataFetcher:
             if not allow_synthetic:
                 raise FileNotFoundError(
                     f"LFW arrays not found under {root}; set DL4J_TPU_LFW_DIR")
+            _log.warning(
+                "LFW arrays not found under %s — using SYNTHETIC faces "
+                "(is_synthetic=True). Point DL4J_TPU_LFW_DIR at real data, "
+                "or pass allow_synthetic=False to fail instead.", root)
             n = num_examples or 1024
             feats, labels = _synthetic_faces(n, num_classes, seed)
         if num_examples is not None:

@@ -81,10 +81,13 @@ class ComposableIterationListener(IterationListener):
 
 
 class ProfilerListener(IterationListener):
-    """Captures a JAX/XLA profiler trace (XPlane + TensorBoard format) over
-    iterations [start, start+duration).  The tracing analog of SURVEY.md §5:
-    the reference has only wall-clock listeners; on TPU the XLA profile
-    shows per-op device time, HBM traffic and fusion decisions.
+    """Captures a JAX/XLA profiler trace (XPlane + TensorBoard format) of
+    the ``duration`` training steps AFTER iteration ``start_iteration`` —
+    the trace opens in step ``start``'s iteration_done callback and closes
+    in step ``start + duration``'s (see ``iteration_done``).  The tracing
+    analog of SURVEY.md §5: the reference has only wall-clock listeners; on
+    TPU the XLA profile shows per-op device time, HBM traffic and fusion
+    decisions.
 
     View with: ``tensorboard --logdir <log_dir>`` (Profile tab), or any
     XPlane consumer."""

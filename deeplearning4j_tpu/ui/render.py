@@ -87,6 +87,8 @@ class ConvolutionalIterationListener(IterationListener):
         if iteration % self.frequency != 0:
             return
         acts = model.feed_forward(self.probe[:1])
+        if isinstance(acts, dict):  # ComputationGraph: name -> activation
+            acts = list(acts.values())
         chosen = None
         for i, a in enumerate(acts):
             arr = np.asarray(a)

@@ -69,11 +69,39 @@ def make_lstm():
     return MultiLayerNetwork(conf).init()
 
 
+def make_graph():
+    """DAG fixture: merge of two inputs (the CG zip layout must stay
+    restorable too)."""
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    from deeplearning4j_tpu.models.vertices import MergeVertex
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    b = (NeuralNetConfiguration.builder().seed(42)
+         .updater("adam", learning_rate=0.01).graph()
+         .add_inputs("a", "b"))
+    b.add_layer("da", DenseLayer(n_in=3, n_out=6, activation="relu"), "a")
+    b.add_layer("db", DenseLayer(n_in=2, n_out=6, activation="relu"), "b")
+    b.add_vertex("m", MergeVertex(), "da", "db")
+    b.add_layer("out", OutputLayer(n_in=12, n_out=2), "m")
+    return ComputationGraph(b.set_outputs("out").build()).init()
+
+
+def make_transformer():
+    """Composite-layer fixture (ResidualBlock + attention + layernorm nest
+    in the zip manifest)."""
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    return transformer_char_lm(vocab_size=7, d_model=8, n_heads=2, layers=1,
+                               seed=42)
+
+
 def main():
     from deeplearning4j_tpu.models.serialization import write_model
 
     FIXTURES.mkdir(exist_ok=True)
     rs = np.random.RandomState(7)
+    tid = rs.randint(0, 7, (2, 6))
     cases = {
         "mlp": (make_mlp(), rs.rand(4, 6).astype(np.float32),
                 np.eye(3, dtype=np.float32)[rs.randint(0, 3, 4)]),
@@ -81,9 +109,19 @@ def main():
                 np.eye(2, dtype=np.float32)[rs.randint(0, 2, 4)]),
         "lstm": (make_lstm(), rs.rand(2, 6, 5).astype(np.float32),
                  np.eye(4, dtype=np.float32)[rs.randint(0, 4, (2, 6))]),
+        "transformer": (make_transformer(), tid.astype(np.float32),
+                        np.eye(7, dtype=np.float32)[np.roll(tid, -1, 1)]),
     }
-    meta = {}
+    # INCREMENTAL: a case whose zip is already committed is an old-build
+    # artifact — regenerating it would destroy exactly the backward-compat
+    # evidence the corpus exists to provide.  Delete a zip deliberately to
+    # regenerate that case (format-version bumps only).
+    meta_path = FIXTURES / "meta.json"
+    meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
     for name, (net, x, y) in cases.items():
+        if (FIXTURES / f"{name}.zip").exists():
+            print(f"  {name}: exists, kept")
+            continue
         for _ in range(3):  # non-trivial updater state
             net.fit(x, y)
         write_model(net, FIXTURES / f"{name}.zip")
@@ -92,7 +130,25 @@ def main():
         np.save(FIXTURES / f"{name}_expected.npy", out)
         meta[name] = {"score": float(net.score_value),
                       "iterations": net.iteration}
-    (FIXTURES / "meta.json").write_text(json.dumps(meta, indent=2))
+
+    # CG fixture (two inputs — stored as separate arrays)
+    if not (FIXTURES / "graph.zip").exists():
+        cg = make_graph()
+        xa = rs.rand(4, 3).astype(np.float32)
+        xb = rs.rand(4, 2).astype(np.float32)
+        yg = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 4)]
+        for _ in range(3):
+            cg.fit({"a": xa, "b": xb}, yg)
+        write_model(cg, FIXTURES / "graph.zip")
+        np.save(FIXTURES / "graph_input_a.npy", xa)
+        np.save(FIXTURES / "graph_input_b.npy", xb)
+        np.save(FIXTURES / "graph_expected.npy",
+                np.asarray(cg.output({"a": xa, "b": xb})))
+        meta["graph"] = {"score": float(cg.score_value),
+                         "iterations": cg.iteration}
+    else:
+        print("  graph: exists, kept")
+    meta_path.write_text(json.dumps(meta, indent=2))
     print("fixtures written to", FIXTURES)
 
 

@@ -190,3 +190,31 @@ def test_lbfgs_solves_xor_fully():
         net.fit(x, y)
     preds = np.asarray(net.output(x))
     assert (preds.argmax(-1) == y.argmax(-1)).all()
+
+
+def test_early_stopping_with_computation_graph():
+    """The trainer is facade-generic: a ComputationGraph trains, saves, and
+    restores through the same early-stopping loop (reference
+    EarlyStoppingGraphTrainer)."""
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    b = (NeuralNetConfiguration.builder().seed(3)
+         .updater("adam", learning_rate=0.1).graph()
+         .add_inputs("in")
+         .add_layer("h", DenseLayer(n_in=2, n_out=8, activation="tanh"), "in")
+         .add_layer("out", OutputLayer(n_in=8, n_out=2), "h")
+         .set_outputs("out"))
+    net = ComputationGraph(b.build()).init()
+    cfg = (EarlyStoppingConfiguration.Builder()
+           .epoch_termination_conditions(MaxEpochsTerminationCondition(8))
+           .score_calculator(DataSetLossCalculator(xor_iter()))
+           .model_saver(InMemoryModelSaver())
+           .build())
+    result = EarlyStoppingTrainer(cfg, net, xor_iter()).fit()
+    assert result.total_epochs == 8
+    assert result.best_model is not None
+    assert np.isfinite(result.best_model_score)
+    scores = list(result.score_vs_epoch.values())
+    assert scores[-1] < scores[0]  # xor is learnable by epoch 8

@@ -11,10 +11,12 @@ from pathlib import Path
 import numpy as np
 import pytest
 
-from deeplearning4j_tpu.models.serialization import restore_multi_layer_network
+from deeplearning4j_tpu.models.serialization import (
+    restore_computation_graph, restore_multi_layer_network,
+)
 
 FIXTURES = Path(__file__).parent / "regression_fixtures"
-CASES = ["mlp", "cnn", "lstm"]
+CASES = ["mlp", "cnn", "lstm", "transformer"]
 
 
 @pytest.mark.parametrize("name", CASES)
@@ -36,11 +38,27 @@ def test_restored_checkpoint_resumes_training(name):
         y = np.eye(3, dtype=np.float32)[np.zeros(len(x), int)]
     elif name == "cnn":
         y = np.eye(2, dtype=np.float32)[np.zeros(len(x), int)]
+    elif name == "transformer":
+        y = np.eye(7, dtype=np.float32)[np.zeros((x.shape[0], x.shape[1]), int)]
     else:
         y = np.eye(4, dtype=np.float32)[np.zeros((x.shape[0], x.shape[1]), int)]
     net.fit(x, y)  # updater state restored -> continues without error
     assert np.isfinite(net.score_value)
     assert meta[name]["iterations"] == 3
+
+
+def test_restore_committed_graph_checkpoint():
+    """CG zip layout (DAG config + per-vertex params) stays restorable."""
+    cg = restore_computation_graph(FIXTURES / "graph.zip")
+    xa = np.load(FIXTURES / "graph_input_a.npy")
+    xb = np.load(FIXTURES / "graph_input_b.npy")
+    expected = np.load(FIXTURES / "graph_expected.npy")
+    out = np.asarray(cg.output({"a": xa, "b": xb}))
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-4)
+    # resumes with restored updater state
+    y = np.eye(2, dtype=np.float32)[np.zeros(len(xa), int)]
+    cg.fit({"a": xa, "b": xb}, y)
+    assert np.isfinite(cg.score_value)
 
 
 def test_updater_state_round_trips(tmp_path):
