@@ -222,7 +222,7 @@ def bench_resnet50(platform, baselines, peak):
 
     from deeplearning4j_tpu.models.zoo import resnet50
 
-    batches = [128, 64, 32] if platform == "tpu" else [4]
+    batches = [256, 128, 64, 32] if platform == "tpu" else [4]
     last_err = None
     for batch in batches:
         try:

@@ -88,7 +88,10 @@ class ConvolutionalIterationListener(IterationListener):
             return
         acts = model.feed_forward(self.probe[:1])
         if isinstance(acts, dict):  # ComputationGraph: name -> activation
-            acts = list(acts.values())
+            # drop input vertices so index semantics match the MLN list
+            # (acts is seeded with the raw inputs, which are also rank-4)
+            inputs = set(getattr(model.conf, "inputs", ()))
+            acts = [a for name, a in acts.items() if name not in inputs]
         chosen = None
         for i, a in enumerate(acts):
             arr = np.asarray(a)

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# One-shot real-chip evidence run (use when the device tunnel is healthy):
+#   1. real-TPU test tier (compiled Pallas, donation, bf16, mesh step)
+#   2. XPlane profile traces + summary (profiles/)
+#   3. benchmark JSON (ResNet-50 imgs/sec + MFU, LeNet, GravesLSTM)
+# Each stage is independently timeboxed so a wedged tunnel fails fast.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== tunnel smoke (60s timebox)"
+timeout 60 python -c "import jax, jax.numpy as jnp; print('tunnel OK:', float(jnp.ones((8,8)).sum()))" \
+  || { echo "tunnel down — aborting"; exit 1; }
+
+echo "== TPU test tier"
+timeout 1200 env DL4J_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+
+echo "== profile traces"
+timeout 1200 python profile_tpu.py
+
+echo "== bench"
+timeout 1800 python bench.py

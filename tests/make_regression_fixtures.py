@@ -118,8 +118,17 @@ def main():
     # regenerate that case (format-version bumps only).
     meta_path = FIXTURES / "meta.json"
     meta = json.loads(meta_path.read_text()) if meta_path.exists() else {}
+
+    def complete(name):
+        """Keep a case only when ALL its artifacts exist (zip + npys + meta
+        entry); a partial case is regenerated rather than left broken."""
+        return ((FIXTURES / f"{name}.zip").exists()
+                and (FIXTURES / f"{name}_input.npy").exists()
+                and (FIXTURES / f"{name}_expected.npy").exists()
+                and name in meta)
+
     for name, (net, x, y) in cases.items():
-        if (FIXTURES / f"{name}.zip").exists():
+        if complete(name):
             print(f"  {name}: exists, kept")
             continue
         for _ in range(3):  # non-trivial updater state
@@ -132,7 +141,11 @@ def main():
                       "iterations": net.iteration}
 
     # CG fixture (two inputs — stored as separate arrays)
-    if not (FIXTURES / "graph.zip").exists():
+    graph_ok = ((FIXTURES / "graph.zip").exists()
+                and all((FIXTURES / f"graph_{s}.npy").exists()
+                        for s in ("input_a", "input_b", "expected"))
+                and "graph" in meta)
+    if not graph_ok:
         cg = make_graph()
         xa = rs.rand(4, 3).astype(np.float32)
         xb = rs.rand(4, 2).astype(np.float32)
