@@ -11,11 +11,15 @@ echo "== tunnel smoke (60s timebox)"
 timeout 60 python -c "import jax, jax.numpy as jnp; print('tunnel OK:', float(jnp.ones((8,8)).sum()))" \
   || { echo "tunnel down — aborting"; exit 1; }
 
+rc=0
 echo "== TPU test tier"
-timeout 1200 env DL4J_TPU_TESTS=1 python -m pytest tests/ -m tpu -q
+timeout 1200 env DL4J_TPU_TESTS=1 python -m pytest tests/ -m tpu -q \
+  || { echo "TPU test tier FAILED"; rc=1; }
 
 echo "== profile traces"
-timeout 1200 python profile_tpu.py
+timeout 1200 python profile_tpu.py || { echo "profiling FAILED"; rc=1; }
 
 echo "== bench"
-timeout 1800 python bench.py
+timeout 1800 python bench.py || { echo "bench FAILED"; rc=1; }
+
+exit $rc
