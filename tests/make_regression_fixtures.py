@@ -143,7 +143,10 @@ def main():
             meta[name] = {"score": float(old.score_value)
                           if old.score_value == old.score_value else None,
                           "iterations": old.iteration}
-            print(f"  {name}: zip kept, sidecars rebuilt from it")
+            print(f"  {name}: zip kept, sidecars rebuilt from it "
+                  "(NOTE: output baseline re-derived by the CURRENT build — "
+                  "old-build output parity is no longer what this case "
+                  "checks; restore the committed sidecars if possible)")
             continue
         for _ in range(3):  # non-trivial updater state
             net.fit(x, y)
@@ -159,7 +162,23 @@ def main():
                 and all((FIXTURES / f"graph_{s}.npy").exists()
                         for s in ("input_a", "input_b", "expected"))
                 and "graph" in meta)
-    if not graph_ok:
+    if graph_ok:
+        print("  graph: exists, kept")
+    elif (FIXTURES / "graph.zip").exists():
+        # same zip-preservation rule as the MLN cases
+        from deeplearning4j_tpu.models.serialization import load_model
+
+        old = load_model(FIXTURES / "graph.zip")
+        xa = rs.rand(4, 3).astype(np.float32)
+        xb = rs.rand(4, 2).astype(np.float32)
+        np.save(FIXTURES / "graph_input_a.npy", xa)
+        np.save(FIXTURES / "graph_input_b.npy", xb)
+        np.save(FIXTURES / "graph_expected.npy",
+                np.asarray(old.output({"a": xa, "b": xb})))
+        meta["graph"] = {"score": None, "iterations": old.iteration}
+        print("  graph: zip kept, sidecars rebuilt from it "
+              "(NOTE: output baseline re-derived by the CURRENT build)")
+    else:
         cg = make_graph()
         xa = rs.rand(4, 3).astype(np.float32)
         xb = rs.rand(4, 2).astype(np.float32)
@@ -173,8 +192,6 @@ def main():
                 np.asarray(cg.output({"a": xa, "b": xb})))
         meta["graph"] = {"score": float(cg.score_value),
                          "iterations": cg.iteration}
-    else:
-        print("  graph: exists, kept")
     meta_path.write_text(json.dumps(meta, indent=2))
     print("fixtures written to", FIXTURES)
 

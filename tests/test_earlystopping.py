@@ -218,3 +218,24 @@ def test_early_stopping_with_computation_graph():
     assert np.isfinite(result.best_model_score)
     scores = list(result.score_vs_epoch.values())
     assert scores[-1] < scores[0]  # xor is learnable by epoch 8
+
+
+def test_computation_graph_trains_with_lbfgs():
+    """CG's solver path (Solver.java dispatch on a DAG facade)."""
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    b = (NeuralNetConfiguration.builder().seed(5)
+         .optimization_algo("lbfgs").iterations(50).graph()
+         .add_inputs("in")
+         .add_layer("h", DenseLayer(n_in=2, n_out=8, activation="tanh"), "in")
+         .add_layer("out", OutputLayer(n_in=8, n_out=2), "h")
+         .set_outputs("out"))
+    net = ComputationGraph(b.build()).init()
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], np.float32)
+    y = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float32)
+    s0 = net.score(x, y)
+    net.fit(x, y)
+    net.fit(x, y)
+    assert net.score(x, y) < s0
