@@ -547,31 +547,32 @@ class ComputationGraph(LazyScoreMixin):
                 "input; use backprop_type='standard' for feed-forward graphs")
         T = max(temporal)
         L = self.conf.tbptt_fwd_length
-
-        def _slice_data(tree, sl):
-            """Time-slice rank-3 sequences; rank-2 arrays are static
-            feed-forward features / one-hot labels, passed whole."""
-            if tree is None:
-                return None
-            return jax.tree_util.tree_map(
-                lambda a: a[:, sl] if np.ndim(a) >= 3 else a, tree)
-
-        def _slice_mask(tree, sl):
-            """Masks are [batch, time] — rank-2 IS temporal here."""
-            if tree is None:
-                return None
-            return jax.tree_util.tree_map(
-                lambda a: a[:, sl] if np.ndim(a) >= 2 else a, tree)
-
         carries = None
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
             carries = self._one_step(
-                _slice_data(x, sl), _slice_data(y, sl),
-                _slice_mask(fm, sl), _slice_mask(lm, sl),
+                self._tbptt_slice_data(x, sl), self._tbptt_slice_data(y, sl),
+                self._tbptt_slice_mask(fm, sl), self._tbptt_slice_mask(lm, sl),
                 carries,
             )
             carries = jax.lax.stop_gradient(carries)
+
+    @staticmethod
+    def _tbptt_slice_data(tree, sl):
+        """Time-slice rank-3 sequences; rank-2 arrays are static
+        feed-forward features / one-hot labels, passed whole."""
+        if tree is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda a: a[:, sl] if np.ndim(a) >= 3 else a, tree)
+
+    @staticmethod
+    def _tbptt_slice_mask(tree, sl):
+        """Masks are [batch, time] — rank-2 IS temporal here."""
+        if tree is None:
+            return None
+        return jax.tree_util.tree_map(
+            lambda a: a[:, sl] if np.ndim(a) >= 2 else a, tree)
 
     def _fit_solver(self, x, y, fm, lm):
         """Full-batch solver path (CG/LBFGS/line-search GD); see

@@ -391,7 +391,10 @@ class MultiLayerNetwork(LazyScoreMixin):
             lr = layer.learning_rate or self.conf.updater.learning_rate
             for _ in range(epochs):
                 for batch in batches:
-                    x = jnp.asarray(self._unpack(batch)[0])
+                    # bare feature arrays are fine here: pretraining is
+                    # unsupervised, labels are ignored even when present
+                    x = jnp.asarray(batch if hasattr(batch, "ndim")
+                                    else self._unpack(batch)[0])
                     # feed through earlier layers (test mode)
                     for j in range(i):
                         if j in self.conf.preprocessors:

@@ -15,6 +15,7 @@ from typing import Optional, Sequence
 from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.layers import (
+    RBM,
     BatchNormalization,
     ConvolutionLayer,
     DenseLayer,
@@ -26,7 +27,7 @@ from deeplearning4j_tpu.nn.layers import (
 )
 from deeplearning4j_tpu.models.graph import ComputationGraph, GraphConfiguration
 from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
-from deeplearning4j_tpu.models.vertices import ElementWiseVertex
+from deeplearning4j_tpu.models.vertices import ElementWiseVertex, MergeVertex
 
 
 def lenet(seed: int = 12345, updater: str = "nesterovs", lr: float = 0.01,
@@ -189,6 +190,121 @@ def vgg16(height: int = 224, width: int = 224, channels: int = 3,
       .layer(DenseLayer(n_out=4096, activation="relu", dropout=0.5))
       .layer(OutputLayer(n_out=n_classes, loss="mcxent", activation="softmax"))
       .set_input_type(InputType.convolutional(height, width, channels)))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def _inception(g, name: str, in_name: str, c1: int, c3r: int, c3: int,
+               c5r: int, c5: int, cp: int) -> str:
+    """GoogLeNet inception module: four parallel branches (1x1 | 1x1->3x3 |
+    1x1->5x5 | maxpool->1x1) channel-concatenated via MergeVertex."""
+    g.add_layer(f"{name}_b1", ConvolutionLayer(
+        n_out=c1, kernel_size=(1, 1), activation="relu", weight_init="relu"),
+        in_name)
+    g.add_layer(f"{name}_b2r", ConvolutionLayer(
+        n_out=c3r, kernel_size=(1, 1), activation="relu", weight_init="relu"),
+        in_name)
+    g.add_layer(f"{name}_b2", ConvolutionLayer(
+        n_out=c3, kernel_size=(3, 3), padding=(1, 1), activation="relu",
+        weight_init="relu"), f"{name}_b2r")
+    g.add_layer(f"{name}_b3r", ConvolutionLayer(
+        n_out=c5r, kernel_size=(1, 1), activation="relu", weight_init="relu"),
+        in_name)
+    g.add_layer(f"{name}_b3", ConvolutionLayer(
+        n_out=c5, kernel_size=(5, 5), padding=(2, 2), activation="relu",
+        weight_init="relu"), f"{name}_b3r")
+    g.add_layer(f"{name}_b4p", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(1, 1), padding=(1, 1)),
+        in_name)
+    g.add_layer(f"{name}_b4", ConvolutionLayer(
+        n_out=cp, kernel_size=(1, 1), activation="relu", weight_init="relu"),
+        f"{name}_b4p")
+    g.add_vertex(f"{name}_cat", MergeVertex(), f"{name}_b1", f"{name}_b2",
+                 f"{name}_b3", f"{name}_b4")
+    return f"{name}_cat"
+
+
+def googlenet(height: int = 224, width: int = 224, channels: int = 3,
+              n_classes: int = 1000, seed: int = 12345,
+              updater: str = "nesterovs", lr: float = 0.01,
+              compute_dtype: Optional[str] = None) -> ComputationGraph:
+    """GoogLeNet / Inception-v1 as a ComputationGraph — the era model whose
+    parallel-branch modules exercise MergeVertex channel concatenation at
+    benchmark scale (the reference's DAG merge capability,
+    ``nn/graph/vertex/impl/MergeVertex.java``)."""
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater, learning_rate=lr)
+        .regularization(True)
+        .l2(2e-4)
+        .graph()
+        .add_inputs("input")
+        .set_input_types(input=InputType.convolutional(height, width, channels))
+    )
+    if compute_dtype:
+        b.compute_dtype(compute_dtype)
+    b.add_layer("stem1", ConvolutionLayer(
+        n_out=64, kernel_size=(7, 7), stride=(2, 2), padding=(3, 3),
+        activation="relu", weight_init="relu"), "input")
+    b.add_layer("pool1", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)),
+        "stem1")
+    b.add_layer("stem2r", ConvolutionLayer(
+        n_out=64, kernel_size=(1, 1), activation="relu", weight_init="relu"),
+        "pool1")
+    b.add_layer("stem2", ConvolutionLayer(
+        n_out=192, kernel_size=(3, 3), padding=(1, 1), activation="relu",
+        weight_init="relu"), "stem2r")
+    b.add_layer("pool2", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)),
+        "stem2")
+    # (c1, c3r, c3, c5r, c5, cp) per module — the published v1 table
+    prev = _inception(b, "i3a", "pool2", 64, 96, 128, 16, 32, 32)
+    prev = _inception(b, "i3b", prev, 128, 128, 192, 32, 96, 64)
+    b.add_layer("pool3", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)),
+        prev)
+    prev = _inception(b, "i4a", "pool3", 192, 96, 208, 16, 48, 64)
+    prev = _inception(b, "i4b", prev, 160, 112, 224, 24, 64, 64)
+    prev = _inception(b, "i4c", prev, 128, 128, 256, 24, 64, 64)
+    prev = _inception(b, "i4d", prev, 112, 144, 288, 32, 64, 64)
+    prev = _inception(b, "i4e", prev, 256, 160, 320, 32, 128, 128)
+    b.add_layer("pool4", SubsamplingLayer(
+        pooling_type="max", kernel_size=(3, 3), stride=(2, 2), padding=(1, 1)),
+        prev)
+    prev = _inception(b, "i5a", "pool4", 256, 160, 320, 32, 128, 128)
+    prev = _inception(b, "i5b", prev, 384, 192, 384, 48, 128, 128)
+    b.add_layer("gap", GlobalPoolingLayer(pooling_type="avg"), prev)
+    b.add_layer("fc", OutputLayer(n_out=n_classes, loss="mcxent",
+                                  activation="softmax", weight_init="xavier",
+                                  dropout=0.4), "gap")
+    conf = b.set_outputs("fc").build()
+    return ComputationGraph(conf).init()
+
+
+def dbn(n_in: int = 784, hidden: Sequence[int] = (500, 250, 100),
+        n_classes: int = 10, seed: int = 12345, updater: str = "nesterovs",
+        lr: float = 0.1, k: int = 1) -> MultiLayerNetwork:
+    """Deep Belief Network — stacked RBMs + softmax output, trained by
+    layerwise CD-k ``pretrain`` then supervised ``fit`` (the reference's
+    historical flagship workflow: RBM contrastive divergence
+    ``nn/layers/feedforward/rbm/RBM.java:66,99`` under
+    ``MultiLayerNetwork.pretrain`` ``MultiLayerNetwork.java:164``)."""
+    b = (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater, learning_rate=lr)
+        .list()
+    )
+    prev = n_in
+    for li, h in enumerate(hidden):
+        # first RBM sees real-valued inputs (gaussian visible); deeper ones
+        # see sigmoid activations in [0,1] (binary visible)
+        b.layer(RBM(n_in=prev, n_out=h, hidden_unit="binary",
+                    visible_unit="gaussian" if li == 0 else "binary", k=k))
+        prev = h
+    b.layer(OutputLayer(n_in=prev, n_out=n_classes, loss="mcxent",
+                        activation="softmax"))
     return MultiLayerNetwork(b.build()).init()
 
 

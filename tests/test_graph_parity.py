@@ -353,14 +353,16 @@ def test_graph_tbptt_slicing_semantics():
     static features/one-hot labels pass whole, rank-2 masks ARE temporal."""
     net = _lstm_graph(tbptt=4)
     data = {"seq": np.zeros((2, 12, 3)), "static": np.zeros((2, 5))}
-    # grab the inner slicers by running one window step path manually
-    import jax
-
     sl = slice(0, 4)
-    sliced = jax.tree_util.tree_map(
-        lambda a: a[:, sl] if np.ndim(a) >= 3 else a, data)
+    sliced = ComputationGraph._tbptt_slice_data(data, sl)
     assert sliced["seq"].shape == (2, 4, 3)
     assert sliced["static"].shape == (2, 5)  # untouched
+    masks = {"seq": np.zeros((2, 12)), "out": np.zeros((2, 12))}
+    msliced = ComputationGraph._tbptt_slice_mask(masks, sl)
+    assert msliced["seq"].shape == (2, 4)
+    assert msliced["out"].shape == (2, 4)
+    assert ComputationGraph._tbptt_slice_data(None, sl) is None
+    assert ComputationGraph._tbptt_slice_mask(None, sl) is None
     # end-to-end: a graph with no rank-3 input must refuse TBPTT loudly
     with pytest.raises(ValueError, match="rank-3"):
         net._fit_tbptt({"in": np.zeros((2, 5), np.float32)},

@@ -35,6 +35,47 @@ def test_vgg16_builds_and_trains_tiny():
     _train_tiny(net, 32, 4)
 
 
+def test_googlenet_builds_and_trains_tiny():
+    """Inception modules (4-branch MergeVertex concat) compile and train."""
+    from deeplearning4j_tpu.models.zoo import googlenet
+
+    net = googlenet(height=64, width=64, n_classes=5, lr=0.001)
+    # 9 inception modules x 4 branches concatenated
+    assert any(n.name == "i5b_cat" for n in net.conf.nodes)
+    rs = np.random.RandomState(0)
+    x = {"input": rs.rand(2, 64, 64, 3).astype(np.float32)}
+    y = {"fc": np.eye(5, dtype=np.float32)[rs.randint(0, 5, 2)]}
+    net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 5)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+
+def test_dbn_pretrain_then_finetune():
+    """Stacked-RBM DBN: layerwise CD-k pretrain changes RBM weights, then
+    supervised fit converges on a separable toy problem."""
+    import jax
+
+    from deeplearning4j_tpu.models.zoo import dbn
+
+    net = dbn(n_in=12, hidden=(8, 6), n_classes=2, lr=0.05)
+    rs = np.random.RandomState(0)
+    x = rs.rand(32, 12).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0.5).astype(int)]
+    w_before = np.asarray(jax.device_get(net.params["layer_0"]["W"]))
+    net.pretrain([x], epochs=2)
+    w_after = np.asarray(jax.device_get(net.params["layer_0"]["W"]))
+    assert not np.allclose(w_before, w_after), "pretrain did not touch RBM 0"
+    net.fit(x, y)
+    first_score = float(net.score_value)
+    for _ in range(30):
+        net.fit(x, y)
+    assert np.isfinite(net.score_value)
+    assert float(net.score_value) < first_score, "supervised fit did not learn"
+    assert np.asarray(net.output(x)).shape == (32, 2)
+
+
 def test_zoo_configs_serialize():
     net = alexnet(height=67, width=67, n_classes=5)
     from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
