@@ -314,6 +314,72 @@ def bench_graves_lstm(platform, baselines, peak):
     }
 
 
+def bench_transformer(platform, baselines, peak):
+    """Long-context transformer char-LM (flash-attention Pallas path) —
+    the framework's TPU-first flagship; no reference analog (pre-transformer
+    codebase), benched for the MFU story."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    if platform == "tpu":
+        batch, seq, d_model, heads, layers = 16, 2048, 512, 8, 4
+    else:
+        batch, seq, d_model, heads, layers = 2, 256, 64, 2, 1
+    vocab = 128
+    net = transformer_char_lm(vocab_size=vocab, d_model=d_model,
+                              n_heads=heads, layers=layers,
+                              compute_dtype="bfloat16" if platform == "tpu" else None)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
+    step = net._get_train_step()
+    state = [net.params, net.updater_state, net.net_state]
+    flops, compiled = _compile_step(step, state[0], state[1], state[2],
+                                    jnp.zeros(()), x, y, net._keys.next(),
+                                    None, None, None)
+    # XLA cost analysis reports the Pallas flash-attention custom call as
+    # zero FLOPs; use the standard analytic transformer count instead
+    # (6·N·tokens for the dense matmuls fwd+bwd, 12·L·H·T²·Dh for
+    # attention, halved for causal masking) and keep whichever is larger.
+    n_params = net.num_params()
+    analytic = (6.0 * n_params * batch * seq
+                + 12.0 * layers * heads * seq * seq * (d_model // heads)
+                * batch * 0.5)
+    flops_src = "xla_cost_analysis"
+    if analytic > flops:
+        flops, flops_src = analytic, "analytic"
+
+    def one():
+        state[0], state[1], state[2], loss, _ = compiled(
+            state[0], state[1], state[2], jnp.zeros(()), x, y,
+            net._keys.next(), None, None, None)
+        return loss
+
+    warmup, iters = (3, 30) if platform == "tpu" else (1, 3)
+    dt, timing = _checked_time(one, warmup, iters, _sync, flops, peak)
+    toks = batch * seq / dt
+    mfu = (flops / dt / peak) if (flops and peak) else None
+    return {
+        "metric": (f"Transformer char-LM tokens/sec "
+                   f"(d{d_model} L{layers} T{seq}, flash attention)"),
+        "value": round(toks, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no reference analog (pre-transformer)
+        "data": "synthetic",
+        "dtype": "bfloat16" if platform == "tpu" else "float32",
+        "batch": batch,
+        "seq_len": seq,
+        "flops_per_step": flops,
+        "flops_source": flops_src,
+        "step_ms": round(dt * 1e3, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "timing": timing,
+    }
+
+
 def main():
     baselines = _load_baselines()
     devices = _devices_with_retry()
@@ -325,7 +391,8 @@ def main():
     errors = []
     for fn in (lambda: bench_resnet50(platform, baselines, peak),
                lambda: bench_lenet(platform, baselines),
-               lambda: bench_graves_lstm(platform, baselines, peak)):
+               lambda: bench_graves_lstm(platform, baselines, peak),
+               lambda: bench_transformer(platform, baselines, peak)):
         try:
             metrics.append(fn())
         except Exception as e:

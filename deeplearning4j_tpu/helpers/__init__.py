@@ -29,6 +29,14 @@ _enabled = os.environ.get("DL4J_TPU_DISABLE_HELPERS", "0") != "1"
 _registry: Dict[str, object] = {}
 
 
+def interpret_mode() -> bool:
+    """Pallas kernels compile on TPU and run ``interpret=True`` elsewhere
+    (single policy for every kernel in this package)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def enable_helpers(on: bool = True) -> None:
     """Toggle helper discovery.  NOTE: discovery happens at TRACE time, so
     already-jitted programs (e.g. a model's cached train/output step) keep

@@ -30,8 +30,8 @@ from jax.experimental.pallas import tpu as pltpu
 from deeplearning4j_tpu import helpers as _helpers
 
 
-def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+# single interpret policy for every kernel in the package
+_interpret = _helpers.interpret_mode
 
 
 def _pad2(x, row_mult=8, lane_mult=128):
@@ -331,3 +331,7 @@ def register_default_helpers() -> None:
         _helpers.register_helper("lrn", PallasLRNHelper())
     if "batch_norm" not in _helpers._registry:
         _helpers.register_helper("batch_norm", PallasBatchNormHelper())
+    if "attention" not in _helpers._registry:
+        from deeplearning4j_tpu.helpers.flash_attention import FlashAttentionHelper
+
+        _helpers.register_helper("attention", FlashAttentionHelper())

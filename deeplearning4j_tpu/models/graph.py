@@ -317,7 +317,9 @@ class ComputationGraph(LazyScoreMixin):
         return self
 
     def num_params(self) -> int:
-        return sum(int(np.prod(p.shape)) for l in self.params.values() for p in l.values())
+        # tree_leaves: composite layers nest their params arbitrarily deep
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
 
     def params_to_vector(self) -> np.ndarray:
         leaves = jax.tree_util.tree_leaves(self.params)

@@ -73,7 +73,9 @@ class MultiLayerNetwork(LazyScoreMixin):
         return {k: v for k, v in params.items() if v}
 
     def num_params(self) -> int:
-        return sum(int(np.prod(p.shape)) for l in self.params.values() for p in l.values())
+        # tree_leaves: composite layers (ResidualBlock) nest their params
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(self.params))
 
     # ----------------------------------------------------- flattened params
     def params_to_vector(self) -> np.ndarray:

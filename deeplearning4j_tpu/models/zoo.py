@@ -335,7 +335,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         ff_mult: int = 4, seed: int = 12345,
                         updater: str = "adam", lr: float = 1e-3,
                         seq_axis: Optional[str] = None,
-                        remat: bool = False) -> MultiLayerNetwork:
+                        remat: bool = False,
+                        compute_dtype: Optional[str] = None) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
@@ -354,6 +355,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
         .updater(updater, learning_rate=lr)
         .list()
     )
+    if compute_dtype:
+        b.compute_dtype(compute_dtype)
     b.layer(EmbeddingLayer(n_in=vocab_size, n_out=d_model))
     for i in range(layers):
         b.layer(ResidualBlock(remat=remat, layers=(

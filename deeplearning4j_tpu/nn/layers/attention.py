@@ -93,6 +93,11 @@ class SelfAttentionLayer(Layer):
     causal: bool = False
     activation: str = "identity"
     seq_axis: Optional[str] = None
+    # fused Pallas flash-attention path via the helper seam
+    # (helpers.get_helper("attention")) — used automatically on TPU when the
+    # shape qualifies (T tiles into blocks) and no padding mask is present;
+    # set False (or DL4J_TPU_DISABLE_HELPERS=1) to force the einsum path
+    flash: bool = True
 
     def setup(self, input_type: InputType) -> "SelfAttentionLayer":
         upd = {}
@@ -132,6 +137,16 @@ class SelfAttentionLayer(Layer):
             o = ring_attention(q, k, v, mask, axis_name=self.seq_axis,
                                causal=self.causal)
         else:
-            o = dot_product_attention(q, k, v, causal=self.causal, mask=mask)
+            o = None
+            if self.flash and mask is None and q.dtype != jnp.float64:
+                from deeplearning4j_tpu.helpers import get_helper
+
+                helper = get_helper("attention")
+                if helper is not None and helper.supports(q.shape[1],
+                                                          q.shape[3]):
+                    o = helper.attend(q, k, v, causal=self.causal)
+            if o is None:
+                o = dot_product_attention(q, k, v, causal=self.causal,
+                                          mask=mask)
         y = merge_heads(o) @ params["Wo"] + params["bo"]
         return activations.get(self.activation)(y), state

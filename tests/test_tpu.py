@@ -210,3 +210,68 @@ def test_resnet_cifar_step_bf16():
     y = {"fc": np.eye(10, dtype=np.float32)[rs.randint(0, 10, 16)]}
     net.fit(x, y)
     assert np.isfinite(net.score_value)
+
+
+def test_flash_attention_compiled_parity():
+    """The flash kernel compiled on the chip (non-interpret) matches the
+    XLA einsum path fwd+bwd to MXU default-precision tolerance, and beats
+    it on step time at the flagship shape (the reason it exists)."""
+    from deeplearning4j_tpu.helpers import flash_attention as fa
+    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+
+    rs = np.random.RandomState(12)
+    q, k, v = (jnp.asarray(rs.randn(2, 512, 4, 64).astype(np.float32) * 0.3)
+               for _ in range(3))
+    ref = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, causal=True))(q, k, v)
+    out = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=5e-3, atol=2e-3)
+
+    def loss(attn, q, k, v):
+        return jnp.sum(attn(q, k, v) ** 2)
+
+    gr = jax.jit(jax.grad(lambda *a: loss(
+        lambda q, k, v: dot_product_attention(q, k, v, causal=True), *a),
+        argnums=(0, 1, 2)))(q, k, v)
+    gf = jax.jit(jax.grad(lambda *a: loss(
+        lambda q, k, v: fa.flash_attention(q, k, v, causal=True), *a),
+        argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gr, gf):
+        # flash's delta=Σ(dO·O) vs autodiff's Σ(p·dp): same math, different
+        # rounding — individual near-cancelled elements disagree at ~1e-2 of
+        # the gradient scale on the MXU (both are equally far from the f64
+        # truth; verified when the kernel landed)
+        scale = float(jnp.max(jnp.abs(a))) + 1e-9
+        np.testing.assert_allclose(np.asarray(b) / scale, np.asarray(a) / scale,
+                                   atol=2e-2, err_msg=f"d{name}")
+
+
+def test_flash_attention_beats_xla_at_scale():
+    """bq512/bk1024 fwd+bwd at B8 T2048 D128 bf16 must be faster than the
+    unfused einsum path (measured 3.4x on v5e; assert a conservative >1.2x
+    so tunnel jitter doesn't flake the tier)."""
+    import time
+
+    from deeplearning4j_tpu.helpers import flash_attention as fa
+    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+
+    rs = np.random.RandomState(13)
+    q, k, v = (jnp.asarray(rs.randn(8, 2048, 8, 128).astype(np.float32) * 0.3,
+                           dtype=jnp.bfloat16) for _ in range(3))
+
+    def bench(attn):
+        g = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        out = g(q, k, v)
+        np.asarray(jax.device_get(out[0][0, 0, 0, :1]))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            out = g(q, k, v)
+        np.asarray(jax.device_get(out[0][0, 0, 0, :1]))
+        return (time.perf_counter() - t0) / 10
+
+    t_xla = bench(lambda q, k, v: dot_product_attention(q, k, v, causal=True))
+    t_flash = bench(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))
+    assert t_flash < t_xla / 1.2, (
+        f"flash {t_flash*1e3:.2f}ms not faster than XLA {t_xla*1e3:.2f}ms")
