@@ -324,7 +324,9 @@ def bench_transformer(platform, baselines, peak):
     from deeplearning4j_tpu.models.zoo import transformer_char_lm
 
     if platform == "tpu":
-        batch, seq, d_model, heads, layers = 16, 2048, 512, 8, 4
+        # GPT-2-medium-class: measured 59.6% MFU on the v5e (PROFILE.md);
+        # width is what fills the MXU (d512 -> 28%, d2048 -> 68%)
+        batch, seq, d_model, heads, layers = 8, 2048, 1024, 8, 8
     else:
         batch, seq, d_model, heads, layers = 2, 256, 64, 2, 1
     vocab = 128
