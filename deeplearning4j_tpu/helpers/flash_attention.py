@@ -129,6 +129,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[:] = m_scr[:] + jnp.log(safe)
 
 
+def _kv_index(causal, block_q, block_k):
+    """K/V block index for q-major grids.  For causal, blocks strictly above
+    the diagonal clamp to the diagonal block: the index stops changing, so
+    the Pallas pipeline skips their HBM->VMEM copies entirely (the compute
+    for those steps is already skipped by the kernels' ``run`` predicate)."""
+    if not causal:
+        return lambda b, qi, ki: (b, ki, 0)
+    return lambda b, qi, ki: (
+        b, jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k), 0)
+
+
+def _q_index(causal, block_q, block_k):
+    """Q-side block index for the k-major (dk/dv) grid: causal q blocks
+    strictly above the diagonal clamp forward to the first valid one."""
+    if not causal:
+        return lambda b, ki, qi: (b, qi, 0)
+    return lambda b, ki, qi: (
+        b, jnp.maximum(qi, (ki * block_k) // block_q), 0)
+
+
 def _fwd_call(q, k, v, *, scale, causal, block_q, block_k, interpret):
     """q,k,v: [BH, T, D] (D already lane-padded). Returns (o, lse[BH,T,128])."""
     bh, t, d = q.shape
@@ -136,13 +156,14 @@ def _fwd_call(q, k, v, *, scale, causal, block_q, block_k, interpret):
     grid = (bh, nq, nk)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              block_q=block_q, block_k=block_k)
+    kv_idx = _kv_index(causal, block_q, block_k)
     return pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
-            pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((None, block_k, d), kv_idx),
+            pl.BlockSpec((None, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0)),
@@ -236,7 +257,8 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     di = jnp.broadcast_to(di[:, :, None], (bh, t, LANES))
 
     qspec = pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0))
-    kspec = pl.BlockSpec((None, block_k, d), lambda b, qi, ki: (b, ki, 0))
+    kv_idx = _kv_index(causal, block_q, block_k)
+    kspec = pl.BlockSpec((None, block_k, d), kv_idx)
     rowq = pl.BlockSpec((None, block_q, LANES), lambda b, qi, ki: (b, qi, 0))
 
     dq = pl.pallas_call(
@@ -253,9 +275,10 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     )(q, k, v, do, lse, di)
 
     # k-major grid: swap the roles of the two minor axes
-    qspec2 = pl.BlockSpec((None, block_q, d), lambda b, ki, qi: (b, qi, 0))
+    q_idx = _q_index(causal, block_q, block_k)
+    qspec2 = pl.BlockSpec((None, block_q, d), q_idx)
     kspec2 = pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0))
-    rowq2 = pl.BlockSpec((None, block_q, LANES), lambda b, ki, qi: (b, qi, 0))
+    rowq2 = pl.BlockSpec((None, block_q, LANES), q_idx)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k),

@@ -50,6 +50,29 @@ def test_gradient_parity(causal):
                                    atol=2e-5, err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("bq,bk", [(64, 128), (128, 64)])
+def test_causal_parity_mixed_block_ratios(bq, bk):
+    """bk > bq is the flagship regime (T=2048 -> bq512/bk1024) and the one
+    the causal diagonal-clamp index maps must get right: several q blocks
+    clamp to one kv block (bk > bq) or the k-major q-index jumps by >1
+    (bq > bk).  Exercise both with explicit small blocks."""
+    q, k, v = (_rand((2, 256, 2, 32), s) for s in (0, 1, 2))
+    ref = dot_product_attention(q, k, v, causal=True)
+    out = fa.flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        dot_product_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        fa.flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gf):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-9
+        np.testing.assert_allclose(np.asarray(b) / scale, np.asarray(a) / scale,
+                                   atol=2e-5, err_msg=f"d{name}")
+
+
 def test_block_picking_and_unsupported():
     assert fa.pick_blocks(2048) == (512, 1024)
     assert fa.pick_blocks(1024) == (512, 512)   # bk capped at T/2
