@@ -69,6 +69,16 @@ def supports(t: int, d: int, block_q: Optional[int] = None,
     return pick_blocks(t, block_q, block_k) is not None
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying the varying-mesh-axes set of ``like`` so
+    the kernels also work inside ``shard_map`` (check_vma requires pallas
+    out_shapes to declare how outputs vary — they vary like q does)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _dot_f32(a, b, trans_a=False, trans_b=False):
     """dot_general with f32 accumulation; contraction picked by flags so we
     never pay an explicit transpose relayout inside the kernel."""
@@ -170,8 +180,8 @@ def _fwd_call(q, k, v, *, scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((None, block_q, LANES), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t, LANES), jnp.float32),
+            _sds((bh, t, d), q.dtype, q),
+            _sds((bh, t, LANES), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -267,7 +277,7 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=_sds((bh, t, d), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -285,8 +295,8 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
         out_specs=[kspec2, kspec2],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-                   jax.ShapeDtypeStruct((bh, t, d), q.dtype)],
+        out_shape=[_sds((bh, t, d), q.dtype, q),
+                   _sds((bh, t, d), q.dtype, q)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -373,8 +383,16 @@ class FlashAttentionHelper:
     def __init__(self, allow_interpret: bool = False):
         self.allow_interpret = allow_interpret
 
-    def supports(self, t: int, d: int) -> bool:
-        if not (self.allow_interpret or jax.default_backend() == "tpu"):
+    def supports(self, t: int, d: int, *, under_shard_map: bool = False) -> bool:
+        """Single routing policy for every call site (the attention layer
+        and the sequence-parallel paths).  ``under_shard_map=True`` adds
+        the constraint that only the compiled path qualifies: the Pallas
+        HLO interpreter cannot execute under shard_map's varying-axes
+        checks."""
+        on_tpu = jax.default_backend() == "tpu"
+        if not (on_tpu or self.allow_interpret):
+            return False
+        if under_shard_map and not on_tpu:
             return False
         return supports(t, d)
 

@@ -121,6 +121,7 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
     sequence, run exact local attention, reshard back.  Requires
     ``H % n_shards == 0``.
     """
+    from deeplearning4j_tpu.helpers import get_helper
     from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
 
     n_shards = lax.psum(1, axis_name)
@@ -128,8 +129,17 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
     def to_heads(x):   # [B, T/P, H, D] -> [B, T, H/P, D]
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
-    o = dot_product_attention(
-        to_heads(q), to_heads(k), to_heads(v), causal=causal)
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    # after resharding the local attention is exact full-T — route it
+    # through the flash helper seam as SelfAttentionLayer does; the helper
+    # owns the policy (under shard_map only the compiled path qualifies)
+    helper = get_helper("attention")
+    if (helper is not None and qh.dtype != jnp.float64
+            and helper.supports(qh.shape[1], qh.shape[3],
+                                under_shard_map=True)):
+        o = helper.attend(qh, kh, vh, causal=causal)
+    else:
+        o = dot_product_attention(qh, kh, vh, causal=causal)
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 

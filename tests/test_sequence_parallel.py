@@ -45,6 +45,29 @@ def test_distributed_attention_matches_exact(causal, impl):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_declines_flash_off_tpu(causal):
+    """Ulysses routes its local attention through the flash helper seam on
+    compiled TPU backends only — on CPU, even an interpret-permissive
+    helper must be bypassed (the Pallas HLO interpreter cannot run under
+    shard_map's varying-axes checks), and the exact path must still hold."""
+    from deeplearning4j_tpu import helpers
+    from deeplearning4j_tpu.helpers.flash_attention import FlashAttentionHelper
+
+    rng = np.random.default_rng(2)
+    q, k, v = _qkv(rng, b=1, t=512, h=4, d=8)
+    mesh = _seq_mesh(4)
+    helpers.register_helper("attention", FlashAttentionHelper(
+        allow_interpret=True))
+    try:
+        got = ring_self_attention(q, k, v, mesh, causal=causal, impl="ulysses")
+    finally:
+        helpers._registry.pop("attention", None)
+    expected = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_attention_gradients_match_exact():
     rng = np.random.default_rng(1)
     q, k, v = _qkv(rng, b=1, t=16, h=2, d=4)

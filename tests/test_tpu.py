@@ -275,3 +275,25 @@ def test_flash_attention_beats_xla_at_scale():
     t_flash = bench(lambda q, k, v: fa.flash_attention(q, k, v, causal=True))
     assert t_flash < t_xla / 1.2, (
         f"flash {t_flash*1e3:.2f}ms not faster than XLA {t_xla*1e3:.2f}ms")
+
+
+def test_ulysses_flash_composes_with_shard_map():
+    """Compiled flash attention under shard_map (1-device 'seq' mesh): the
+    multi-host Ulysses path routes its local attention through the Pallas
+    kernel on TPU — this is the composition a pod run depends on."""
+    from jax.sharding import Mesh
+
+    from deeplearning4j_tpu.backend import device as backend
+    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+    from deeplearning4j_tpu.parallel import ring_self_attention
+
+    devs = np.array(jax.devices()[:1]).reshape(1, 1, 1)
+    mesh = Mesh(devs, (backend.AXIS_DATA, backend.AXIS_MODEL, backend.AXIS_SEQ))
+    rng = np.random.default_rng(14)
+    q = jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.float32) * 0.3
+    k = jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.float32) * 0.3
+    v = jnp.asarray(rng.standard_normal((2, 512, 4, 64)), jnp.float32)
+    got = ring_self_attention(q, k, v, mesh, causal=True, impl="ulysses")
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-3, atol=2e-3)
