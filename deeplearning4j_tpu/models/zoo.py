@@ -357,7 +357,10 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
     )
     if compute_dtype:
         b.compute_dtype(compute_dtype)
-    b.layer(EmbeddingLayer(n_in=vocab_size, n_out=d_model))
+    # collapse_column off: ids are [B, T] sequences; a length-1 prompt must
+    # keep its time axis (see EmbeddingLayer.collapse_column)
+    b.layer(EmbeddingLayer(n_in=vocab_size, n_out=d_model,
+                           collapse_column=False))
     for i in range(layers):
         b.layer(ResidualBlock(remat=remat, layers=(
             LayerNorm(n_in=d_model),

@@ -98,6 +98,9 @@ class SelfAttentionLayer(Layer):
     # shape qualifies (T tiles into blocks) and no padding mask is present;
     # set False (or DL4J_TPU_DISABLE_HELPERS=1) to force the einsum path
     flash: bool = True
+    # streaming-inference KV cache capacity (rnn_time_step); static so the
+    # decode step compiles once
+    max_cache: int = 1024
 
     def setup(self, input_type: InputType) -> "SelfAttentionLayer":
         upd = {}
@@ -125,6 +128,61 @@ class SelfAttentionLayer(Layer):
             p[name] = initializers.init(self.weight_init, k, (fi, fo), dtype)
             p["b" + name[1].lower()] = jnp.zeros((fo,), dtype)
         return p
+
+    def init_cache(self, batch: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
+        """KV cache for streaming inference (``rnn_time_step`` on
+        transformer stacks — the attention analog of the reference's RNN
+        ``stateMap``, ``BaseRecurrentLayer.java``).  Static ``max_cache``
+        length; ``pos`` counts filled timesteps."""
+        d_head = self.n_out // self.n_heads
+        shape = (batch, self.max_cache, self.n_heads, d_head)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                "pos": jnp.zeros((), jnp.int32)}
+
+    @staticmethod
+    def cache_overflow(carry, t_new: int) -> bool:
+        """Would appending ``t_new`` steps exceed the cache?  Checked
+        host-side before dispatch: ``dynamic_update_slice`` CLAMPS an
+        out-of-range start index, which would silently relocate keys."""
+        return int(carry["pos"]) + t_new > carry["k"].shape[1]
+
+    def apply_with_carry(self, params, state, x, carry, *, train=False,
+                         rng=None, mask=None):
+        """carry=None -> exact full-sequence apply (training and batch
+        inference paths are untouched).  With a cache carry: append this
+        call's K/V at ``pos`` and attend the new queries over everything
+        cached so far — O(T_new · pos) per call, the streaming-decode path."""
+        if carry is None:
+            y, st = self.apply(params, state, x, train=train, rng=rng,
+                               mask=mask)
+            return y, st, None
+        if not self.causal or self.seq_axis is not None or mask is not None:
+            raise ValueError(
+                "KV-cache streaming requires causal=True attention without "
+                "seq_axis or padding masks (a non-causal layer would attend "
+                "into the unfilled cache tail); got "
+                f"causal={self.causal}, seq_axis={self.seq_axis}, "
+                f"mask={'set' if mask is not None else None}")
+        x = self.maybe_dropout(x, train=train, rng=rng)
+        q = split_heads(x @ params["Wq"] + params["bq"], self.n_heads)
+        k = split_heads(x @ params["Wk"] + params["bk"], self.n_heads)
+        v = split_heads(x @ params["Wv"] + params["bv"], self.n_heads)
+        t_new = q.shape[1]
+        pos = carry["pos"]
+        zero = jnp.zeros((), pos.dtype)
+        kc = jax.lax.dynamic_update_slice(
+            carry["k"], k.astype(carry["k"].dtype), (zero, pos, zero, zero))
+        vc = jax.lax.dynamic_update_slice(
+            carry["v"], v.astype(carry["v"].dtype), (zero, pos, zero, zero))
+        # causal masking by global position also hides the unfilled tail
+        # (kpos > qpos).  Overflow past max_cache is a hard error, enforced
+        # host-side by rnn_time_step (dynamic_update_slice would clamp the
+        # write and silently relocate keys); see cache_overflow().
+        o = dot_product_attention(q, kc.astype(q.dtype), vc.astype(q.dtype),
+                                  causal=True, q_offset=pos, k_offset=0)
+        y = merge_heads(o) @ params["Wo"] + params["bo"]
+        new_carry = {"k": kc, "v": vc, "pos": pos + t_new}
+        return activations.get(self.activation)(y), state, new_carry
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)

@@ -82,6 +82,44 @@ class ResidualBlock(Layer):
             body = jax.checkpoint(body)
         return body(params, x, rngs, mask), state
 
+    def init_cache(self, batch: int, dtype=jnp.float32):
+        """Streaming carries for cache-bearing sublayers (attention KV
+        caches), or None when the block holds none."""
+        carry = {}
+        for i, sub in enumerate(self.layers):
+            if hasattr(sub, "init_cache"):
+                carry[f"sub{i}"] = sub.init_cache(batch, dtype)
+        return carry or None
+
+    def apply_with_carry(self, params, state, x, carry, *, train=False,
+                         rng=None, mask=None):
+        """carry=None -> exact ``apply`` (training/batch paths untouched).
+        With a carry dict: thread each sublayer's cache through; remat is
+        irrelevant here (streaming is forward-only)."""
+        if carry is None:
+            y, st = self.apply(params, state, x, train=train, rng=rng,
+                               mask=mask)
+            return y, st, None
+        import inspect
+
+        rngs = (jax.random.split(rng, len(self.layers))
+                if rng is not None else [None] * len(self.layers))
+        h = x
+        new_carry = {}
+        for i, sub in enumerate(self.layers):
+            p = params.get(f"sub{i}", {})
+            if f"sub{i}" in carry:
+                h, _, nc = sub.apply_with_carry(
+                    p, {}, h, carry[f"sub{i}"], train=train, rng=rngs[i],
+                    mask=mask)
+                new_carry[f"sub{i}"] = nc
+            else:
+                kw = ({"mask": mask} if mask is not None
+                      and "mask" in inspect.signature(sub.apply).parameters
+                      else {})
+                h, _ = sub.apply(p, {}, h, train=train, rng=rngs[i], **kw)
+        return x + h, state, new_carry
+
     def reg_score(self, params):
         total = jnp.zeros(())
         for i, sub in enumerate(self.layers):

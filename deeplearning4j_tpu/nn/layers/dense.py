@@ -137,6 +137,11 @@ class EmbeddingLayer(Layer):
     n_in: Optional[int] = None   # vocab size
     n_out: Optional[int] = None
     activation: str = "identity"
+    # reference semantics: a [B, 1] input is a COLUMN of indices and embeds
+    # to [B, n_out].  Sequence models (ids [B, T]) must turn this off, or a
+    # length-1 sequence is indistinguishable from a column and loses its
+    # time axis (zoo.transformer_char_lm sets False).
+    collapse_column: bool = True
 
     def setup(self, input_type: InputType) -> "EmbeddingLayer":
         if self.n_in is None:
@@ -160,7 +165,7 @@ class EmbeddingLayer(Layer):
 
     def apply(self, params, state, x, *, train=False, rng=None):
         idx = x.astype(jnp.int32)
-        if idx.ndim >= 2 and idx.shape[-1] == 1:
+        if self.collapse_column and idx.ndim >= 2 and idx.shape[-1] == 1:
             idx = idx[..., 0]
         z = jnp.take(params["W"], idx, axis=0) + params["b"]
         return activations.get(self.activation)(z), state
