@@ -34,3 +34,44 @@ class LazyScoreMixin:
     def score_value(self, value) -> None:
         # accepts a python float OR an on-device scalar (no sync either way)
         self._score = value
+
+
+def seed_stream_caches(named_layers, rnn_state, batch, compute_dtype):
+    """Streaming-cache seeding shared by both facades' ``rnn_time_step``:
+    for every (name, layer) with an ``init_cache`` and no existing carry,
+    allocate a KV cache in the model's compute dtype.  Returns the carries
+    dict (may be empty)."""
+    import jax.numpy as jnp
+
+    cache_dtype = jnp.dtype(compute_dtype) if compute_dtype else jnp.float32
+    carries = dict(rnn_state) if rnn_state else {}
+    for name, layer in named_layers:
+        if hasattr(layer, "init_cache") and name not in carries:
+            cache = layer.init_cache(int(batch), dtype=cache_dtype)
+            if cache is not None:
+                carries[name] = cache
+    return carries
+
+
+def check_cache_capacity(carries, t_new: int) -> None:
+    """Raise before dispatch when a streamed chunk would overflow any
+    attention KV cache — ``dynamic_update_slice`` clamps out-of-range
+    writes and would silently relocate keys instead of failing."""
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    def walk(name, c):
+        if not isinstance(c, dict):
+            return
+        if "pos" in c and "k" in c:
+            if SelfAttentionLayer.cache_overflow(c, t_new):
+                raise ValueError(
+                    f"rnn_time_step: streaming past the KV cache of "
+                    f"'{name}' (pos={int(c['pos'])} + {t_new} > "
+                    f"max_cache={c['k'].shape[1]}); raise the layer's "
+                    "max_cache or rnn_clear_previous_state()")
+        else:
+            for k, v in c.items():
+                walk(f"{name}.{k}", v)
+
+    for name, c in (carries or {}).items():
+        walk(name, c)

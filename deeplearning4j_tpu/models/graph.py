@@ -685,17 +685,56 @@ class ComputationGraph(LazyScoreMixin):
         """Reference ``ComputationGraph.rnnClearPreviousState`` :1686."""
         self._rnn_state = {}
 
+    def _id_consumer(self, input_name: str):
+        """The EmbeddingLayer consuming this graph input, if any — its
+        inputs are integer token ids, not feature vectors."""
+        from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
+
+        for node in self.nodes.values():
+            if (node.layer is not None
+                    and isinstance(node.layer, EmbeddingLayer)
+                    and input_name in node.inputs):
+                return node.layer
+        return None
+
     def rnn_time_step(self, inputs, fmask=None):
         """Stateful streaming inference (reference
         ``ComputationGraph.rnnTimeStep`` :1674): feed one (or a few)
         timesteps; recurrent-node carries persist across calls."""
+        from deeplearning4j_tpu.models.common import (
+            check_cache_capacity, seed_stream_caches,
+        )
+
         inputs = self._as_input_dict(inputs)
         inputs = {k: jnp.asarray(v) for k, v in inputs.items()}
-        squeeze = any(v.ndim == 2 for v in inputs.values())
-        if squeeze:
-            inputs = {k: (v[:, None, :] if v.ndim == 2 else v)
-                      for k, v in inputs.items()}
-        carries = self._rnn_state or None
+        # per-input expansion: id inputs (feeding an EmbeddingLayer) follow
+        # the MLN id rules; feature inputs treat rank-2 as one timestep
+        squeeze = False
+        expanded = {}
+        for name, v in inputs.items():
+            emb = self._id_consumer(name)
+            if emb is not None:
+                sq = v.ndim == 1 or (
+                    emb.collapse_column and v.ndim == 2 and v.shape[1] == 1)
+                if v.ndim == 1:
+                    v = v[:, None]
+                if v.ndim == 2 and emb.collapse_column:
+                    v = v[..., None]
+            else:
+                sq = v.ndim == 2
+                if sq:
+                    v = v[:, None, :]
+            squeeze = squeeze or sq
+            expanded[name] = v
+        inputs = expanded
+        first = next(iter(inputs.values()))
+        carries = seed_stream_caches(
+            ((n, self.nodes[n].layer) for n in self.topo
+             if self.nodes[n].layer is not None),
+            self._rnn_state, first.shape[0], self.conf.compute_dtype)
+        check_cache_capacity(carries,
+                             int(first.shape[1]) if first.ndim >= 2 else 1)
+        carries = carries or None
         acts, _, new_carries = self._forward(
             self.params, self.net_state, inputs, train=False, rng=None,
             fmask=fmask, carries=carries,

@@ -360,27 +360,9 @@ class MultiLayerNetwork(LazyScoreMixin):
 
     @staticmethod
     def _check_cache_capacity(carries, t_new: int) -> None:
-        """Raise before dispatch when a streamed chunk would overflow any
-        attention KV cache — ``dynamic_update_slice`` clamps out-of-range
-        writes and would silently relocate keys instead of failing."""
-        from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.models.common import check_cache_capacity
 
-        def walk(name, c):
-            if not isinstance(c, dict):
-                return
-            if "pos" in c and "k" in c:
-                if SelfAttentionLayer.cache_overflow(c, t_new):
-                    raise ValueError(
-                        f"rnn_time_step: streaming past the KV cache of "
-                        f"'{name}' (pos={int(c['pos'])} + {t_new} > "
-                        f"max_cache={c['k'].shape[1]}); raise the layer's "
-                        "max_cache or rnn_clear_previous_state()")
-            else:
-                for k, v in c.items():
-                    walk(f"{name}.{k}", v)
-
-        for name, c in (carries or {}).items():
-            walk(name, c)
+        check_cache_capacity(carries, t_new)
 
     def _embeds_ids(self) -> bool:
         """First layer consumes integer token ids (EmbeddingLayer), so a
@@ -395,12 +377,21 @@ class MultiLayerNetwork(LazyScoreMixin):
         Recurrent layers carry hidden state; attention layers carry a KV
         cache (seeded on first call), so transformer stacks stream through
         the same API as LSTMs."""
+        from deeplearning4j_tpu.models.common import (
+            check_cache_capacity, seed_stream_caches,
+        )
+
         x = jnp.asarray(x)
         if self._embeds_ids():
-            squeeze = x.ndim == 1          # [B]: one timestep of token ids
-            if squeeze:
+            collapse = self.layers[0].collapse_column
+            # [B] ids are one timestep; with column semantics, so is [B, 1]
+            # (the reference's column-of-indices form, which the old
+            # streaming contract returned as [B, V])
+            squeeze = x.ndim == 1 or (
+                collapse and x.ndim == 2 and x.shape[1] == 1)
+            if x.ndim == 1:
                 x = x[:, None]
-            if x.ndim == 2 and self.layers[0].collapse_column:
+            if x.ndim == 2 and collapse:
                 # [B, T, 1] keeps the time axis unambiguous for embeddings
                 # that collapse a trailing 1 as a column-of-indices
                 x = x[..., None]
@@ -408,15 +399,10 @@ class MultiLayerNetwork(LazyScoreMixin):
             squeeze = x.ndim == 2          # [B, F]: one timestep of features
             if squeeze:
                 x = x[:, None, :]
-        cache_dtype = (jnp.dtype(self.conf.compute_dtype)
-                       if self.conf.compute_dtype else jnp.float32)
-        carries = dict(self._rnn_state) if self._rnn_state else {}
-        for layer in self.layers:
-            if hasattr(layer, "init_cache") and layer.name not in carries:
-                cache = layer.init_cache(int(x.shape[0]), dtype=cache_dtype)
-                if cache is not None:
-                    carries[layer.name] = cache
-        self._check_cache_capacity(carries, int(x.shape[1]))
+        carries = seed_stream_caches(
+            ((l.name, l) for l in self.layers), self._rnn_state,
+            x.shape[0], self.conf.compute_dtype)
+        check_cache_capacity(carries, int(x.shape[1]))
         carries = carries or None
         pre, _, _, new_carries = self._forward(
             self.params, self.net_state, x, train=False, rng=None, carries=carries

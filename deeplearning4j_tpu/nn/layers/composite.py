@@ -84,12 +84,21 @@ class ResidualBlock(Layer):
 
     def init_cache(self, batch: int, dtype=jnp.float32):
         """Streaming carries for cache-bearing sublayers (attention KV
-        caches), or None when the block holds none."""
+        caches).  Returns a dict (possibly empty) whenever ANY sublayer is
+        carryable — recurrent sublayers seed their own state on first
+        apply_with_carry(None), but the block must enter the carry path for
+        that to happen — and None when the block holds none."""
         carry = {}
+        carryable = False
         for i, sub in enumerate(self.layers):
             if hasattr(sub, "init_cache"):
-                carry[f"sub{i}"] = sub.init_cache(batch, dtype)
-        return carry or None
+                carryable = True
+                c = sub.init_cache(batch, dtype)
+                if c is not None:
+                    carry[f"sub{i}"] = c
+            elif hasattr(sub, "apply_with_carry"):
+                carryable = True
+        return carry if carryable else None
 
     def apply_with_carry(self, params, state, x, carry, *, train=False,
                          rng=None, mask=None):
@@ -108,11 +117,16 @@ class ResidualBlock(Layer):
         new_carry = {}
         for i, sub in enumerate(self.layers):
             p = params.get(f"sub{i}", {})
-            if f"sub{i}" in carry:
+            if hasattr(sub, "apply_with_carry"):
+                # thread the seeded cache (attention) or None (recurrent
+                # sublayers initialize their own state and return it — they
+                # must NOT be applied statelessly here, or their hidden
+                # state would reset every streamed chunk)
                 h, _, nc = sub.apply_with_carry(
-                    p, {}, h, carry[f"sub{i}"], train=train, rng=rngs[i],
-                    mask=mask)
-                new_carry[f"sub{i}"] = nc
+                    p, {}, h, carry.get(f"sub{i}"), train=train,
+                    rng=rngs[i], mask=mask)
+                if nc is not None:
+                    new_carry[f"sub{i}"] = nc
             else:
                 kw = ({"mask": mask} if mask is not None
                       and "mask" in inspect.signature(sub.apply).parameters

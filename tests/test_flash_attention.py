@@ -247,3 +247,51 @@ def test_streaming_requires_causal_unmasked():
         causal.apply_with_carry(cp, {}, _rand((2, 1, 8)),
                                 causal.init_cache(batch=2),
                                 mask=jnp.ones((2, 1)))
+
+
+def test_streaming_rank_contract_column_ids():
+    """Embedding-first nets with column semantics (collapse_column=True):
+    a [B, 1] id column is ONE timestep and rnn_time_step returns [B, V],
+    matching the pre-KV-cache streaming contract."""
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import (
+        EmbeddingLayer, GravesLSTM, RnnOutputLayer,
+    )
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(5)
+         .updater("sgd", learning_rate=0.1).list()
+         .layer(EmbeddingLayer(n_in=9, n_out=6))      # collapse_column=True
+         .layer(GravesLSTM(n_in=6, n_out=6))
+         .layer(RnnOutputLayer(n_in=6, n_out=9)).build())).init()
+    out = net.rnn_time_step(jnp.asarray(np.array([[1], [4]])))   # [B, 1]
+    assert out.shape == (2, 9), out.shape
+    out1 = net.rnn_time_step(jnp.asarray(np.array([2, 5])))      # [B]
+    assert out1.shape == (2, 9), out1.shape
+
+
+def test_residual_block_lstm_sublayer_streams_state():
+    """A recurrent sublayer inside ResidualBlock must carry hidden state
+    across streamed chunks (not reset every call): step-by-step equals the
+    full forward."""
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import (
+        GravesLSTM, LayerNorm, ResidualBlock, RnnOutputLayer,
+    )
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(6)
+         .updater("sgd", learning_rate=0.1).list()
+         .layer(ResidualBlock(layers=(
+             LayerNorm(n_in=5), GravesLSTM(n_in=5, n_out=5))))
+         .layer(RnnOutputLayer(n_in=5, n_out=3)).build())).init()
+    rs = np.random.RandomState(7)
+    x = rs.randn(2, 6, 5).astype(np.float32)
+    full = np.asarray(net.output(jnp.asarray(x)))
+    net.rnn_clear_previous_state()
+    for t in range(6):
+        step = np.asarray(net.rnn_time_step(jnp.asarray(x[:, t])))
+        np.testing.assert_allclose(step, full[:, t], rtol=2e-4, atol=1e-5,
+                                   err_msg=f"t={t}")

@@ -367,3 +367,29 @@ def test_graph_tbptt_slicing_semantics():
     with pytest.raises(ValueError, match="rank-3"):
         net._fit_tbptt({"in": np.zeros((2, 5), np.float32)},
                        {"out": np.zeros((2, 3), np.float32)}, None, None)
+
+
+def test_graph_attention_streaming_matches_full_forward():
+    """CG rnn_time_step seeds attention KV caches like MLN: a causal
+    attention DAG streamed one step at a time reproduces the full
+    forward (reference ``ComputationGraph.rnnTimeStep`` :1674)."""
+    from deeplearning4j_tpu.nn.layers import LayerNorm, RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    b = (NeuralNetConfiguration.builder().seed(3)
+         .updater("sgd", learning_rate=0.01).graph()
+         .add_inputs("seq")
+         .add_layer("attn", SelfAttentionLayer(n_in=6, n_out=6, n_heads=2,
+                                               causal=True), "seq")
+         .add_layer("ln", LayerNorm(n_in=6), "attn")
+         .add_layer("out", RnnOutputLayer(n_in=6, n_out=3), "ln")
+         .set_outputs("out"))
+    net = ComputationGraph(b.build()).init()
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 5, 6).astype(np.float32)
+    full = np.asarray(net.output({"seq": x}))
+    net.rnn_clear_previous_state()
+    for t in range(5):
+        step = np.asarray(net.rnn_time_step({"seq": x[:, t]}))
+        np.testing.assert_allclose(step, full[:, t], rtol=2e-4, atol=1e-5,
+                                   err_msg=f"t={t}")
