@@ -687,15 +687,21 @@ class ComputationGraph(LazyScoreMixin):
 
     def _id_consumer(self, input_name: str):
         """The EmbeddingLayer consuming this graph input, if any — its
-        inputs are integer token ids, not feature vectors."""
-        from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
+        inputs are integer token ids, not feature vectors.  The map is
+        static for the life of the graph; memoized because this sits in
+        the per-token streaming loop."""
+        cache = getattr(self, "_id_consumer_map", None)
+        if cache is None:
+            from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
 
-        for node in self.nodes.values():
-            if (node.layer is not None
-                    and isinstance(node.layer, EmbeddingLayer)
-                    and input_name in node.inputs):
-                return node.layer
-        return None
+            cache = {}
+            for node in self.nodes.values():
+                if node.layer is not None and isinstance(node.layer,
+                                                         EmbeddingLayer):
+                    for inp in node.inputs:
+                        cache[inp] = node.layer
+            self._id_consumer_map = cache
+        return cache.get(input_name)
 
     def rnn_time_step(self, inputs, fmask=None):
         """Stateful streaming inference (reference
@@ -732,8 +738,11 @@ class ComputationGraph(LazyScoreMixin):
             ((n, self.nodes[n].layer) for n in self.topo
              if self.nodes[n].layer is not None),
             self._rnn_state, first.shape[0], self.conf.compute_dtype)
-        check_cache_capacity(carries,
-                             int(first.shape[1]) if first.ndim >= 2 else 1)
+        # the longest time axis across inputs bounds what any attention
+        # cache may be asked to append this call
+        t_new = max((int(v.shape[1]) for v in inputs.values()
+                     if v.ndim >= 2), default=1)
+        check_cache_capacity(carries, t_new)
         carries = carries or None
         acts, _, new_carries = self._forward(
             self.params, self.net_state, inputs, train=False, rng=None,

@@ -358,12 +358,6 @@ class MultiLayerNetwork(LazyScoreMixin):
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
 
-    @staticmethod
-    def _check_cache_capacity(carries, t_new: int) -> None:
-        from deeplearning4j_tpu.models.common import check_cache_capacity
-
-        check_cache_capacity(carries, t_new)
-
     def _embeds_ids(self) -> bool:
         """First layer consumes integer token ids (EmbeddingLayer), so a
         rank-2 streaming input is [B, T] ids, not [B, F] features."""

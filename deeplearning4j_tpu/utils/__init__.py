@@ -3,3 +3,4 @@ from deeplearning4j_tpu.utils.interop import (
     labeled_points_to_dataset, dataset_to_labeled_points,
 )
 from deeplearning4j_tpu.utils.viterbi import Viterbi, viterbi_decode
+from deeplearning4j_tpu.utils.sampling import sample_sequence

@@ -1,0 +1,79 @@
+"""Autoregressive sampling on top of the streaming inference API.
+
+≙ the reference's char-modelling example loop (sampleCharactersFromNetwork
+in the DL4J GravesLSTM example family: prime the RNN with a prompt via
+``rnnTimeStep``, then repeatedly sample from the output distribution and
+feed the sample back).  Works unchanged for both model families because
+both stream through ``rnn_time_step``: LSTMs carry hidden state,
+transformers carry KV caches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def sample_sequence(net, prompt_ids, steps: int, *,
+                    temperature: float = 1.0,
+                    rng: Optional[jax.Array] = None,
+                    one_hot: Optional[bool] = None,
+                    vocab_size: Optional[int] = None) -> np.ndarray:
+    """Generate ``steps`` tokens after priming with ``prompt_ids``.
+
+    prompt_ids: [B, T_prompt] integer array.  ``one_hot`` controls the
+    input encoding per step: True feeds one-hot vectors (LSTM char-LM
+    configs whose first layer consumes features), False feeds raw ids
+    (embedding-first transformers).  Auto-detected from the first layer
+    when None.  ``temperature`` <= 0 means greedy argmax.  Returns the
+    sampled ids [B, steps].
+    """
+    from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
+
+    prompt_ids = np.asarray(prompt_ids)
+    if prompt_ids.ndim != 2:
+        raise ValueError(f"prompt_ids must be [B, T], got {prompt_ids.shape}")
+    layers = getattr(net, "layers", None)   # MLN only; CG has named nodes
+    if one_hot is None:
+        if layers is None:
+            raise ValueError(
+                "one_hot auto-detection needs a sequential net with "
+                ".layers (MultiLayerNetwork); pass one_hot= explicitly "
+                "for a ComputationGraph")
+        one_hot = not (layers and isinstance(layers[0], EmbeddingLayer))
+    if one_hot and vocab_size is None:
+        if layers is None:
+            raise ValueError("pass vocab_size= explicitly for a "
+                             "ComputationGraph with one_hot inputs")
+        vocab_size = layers[-1].n_out
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def encode(ids):
+        ids = np.asarray(ids)
+        if one_hot:
+            return jnp.asarray(np.eye(vocab_size, dtype=np.float32)[ids])
+        return jnp.asarray(ids)
+
+    net.rnn_clear_previous_state()
+    # prime on the full prompt in one chunk; the last step's distribution
+    # seeds the first sample
+    probs = net.rnn_time_step(encode(prompt_ids))
+    probs = probs[:, -1] if probs.ndim == 3 else probs
+
+    out = []
+    tok = None
+    for _ in range(steps):
+        if temperature and temperature > 0:
+            rng, key = jax.random.split(rng)
+            logits = jnp.log(jnp.maximum(probs, 1e-30)) / temperature
+            tok = jax.random.categorical(key, logits, axis=-1)
+        else:
+            tok = jnp.argmax(probs, axis=-1)
+        out.append(np.asarray(tok))
+        probs = net.rnn_time_step(encode(np.asarray(tok)[:, None]))
+        probs = probs[:, -1] if probs.ndim == 3 else probs
+    return np.stack(out, axis=1)
