@@ -336,7 +336,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         updater: str = "adam", lr: float = 1e-3,
                         seq_axis: Optional[str] = None,
                         remat: bool = False,
-                        compute_dtype: Optional[str] = None) -> MultiLayerNetwork:
+                        compute_dtype: Optional[str] = None,
+                        rope: bool = True) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
@@ -344,7 +345,14 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
     sequences sharded over chips without materializing full K/V.  With
     ``remat=True`` each block rematerializes its activations in the
     backward pass (jax.checkpoint) — the other half of the long-context
-    memory budget."""
+    memory budget.
+
+    ``rope=True`` (default since 2026-07-30) adds rotary position
+    embeddings on q/k — parameter-free, so checkpoints are shape-
+    compatible either way, but logits differ: models SAVED with the
+    earlier position-free config reload exactly (the zip carries
+    ``rope`` in the layer config, absent -> False); only params-only
+    reloads through this builder must pass ``rope=False`` explicitly."""
     from deeplearning4j_tpu.nn.layers import (
         EmbeddingLayer, LayerNorm, ResidualBlock, SelfAttentionLayer,
     )
@@ -366,7 +374,7 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
             LayerNorm(n_in=d_model),
             SelfAttentionLayer(n_in=d_model, n_out=d_model,
                                n_heads=n_heads, causal=True,
-                               seq_axis=seq_axis),
+                               seq_axis=seq_axis, rope=rope),
         )))
         b.layer(ResidualBlock(remat=remat, layers=(
             LayerNorm(n_in=d_model),

@@ -42,6 +42,30 @@ def merge_heads(x: jax.Array) -> jax.Array:
     return x.reshape(b, t, h * d)
 
 
+def rope(x: jax.Array, positions: jax.Array,
+         theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding on ``[B, T, H, D]`` (RoFormer; public
+    standard).  ``positions`` is the [T] vector of GLOBAL positions, which
+    is what makes the same function serve the full-sequence path, the
+    streaming KV-cache path (q at ``pos + arange``, k rotated at write
+    time), and ring attention (shard offsets).  Odd tail dims (D not a
+    multiple of 2) pass through unrotated."""
+    d = x.shape[-1]
+    half = d // 2
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    freqs = jnp.power(jnp.asarray(theta, acc),
+                      -jnp.arange(0, half, dtype=acc) / max(half, 1))
+    ang = positions.astype(acc)[:, None] * freqs[None, :]      # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1 = x[..., :half].astype(acc)
+    x2 = x[..., half:2 * half].astype(acc)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin, x[..., 2 * half:].astype(acc)],
+        axis=-1)
+    return out.astype(x.dtype)
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -101,6 +125,12 @@ class SelfAttentionLayer(Layer):
     # streaming-inference KV cache capacity (rnn_time_step); static so the
     # decode step compiles once
     max_cache: int = 1024
+    # rotary position embedding (RoPE) on q/k before attention; parameter-
+    # free, composes with the flash kernel (rotation happens outside it),
+    # the KV cache (keys rotated at write by global position), and the
+    # ring/Ulysses sequence-parallel paths (global shard offsets)
+    rope: bool = False
+    rope_theta: float = 10000.0
 
     def setup(self, input_type: InputType) -> "SelfAttentionLayer":
         upd = {}
@@ -169,6 +199,11 @@ class SelfAttentionLayer(Layer):
         v = split_heads(x @ params["Wv"] + params["bv"], self.n_heads)
         t_new = q.shape[1]
         pos = carry["pos"]
+        if self.rope:
+            # rotate by GLOBAL position; cached keys are stored rotated
+            new_pos = pos + jnp.arange(t_new)
+            q = rope(q, new_pos, self.rope_theta)
+            k = rope(k, new_pos, self.rope_theta)
         zero = jnp.zeros((), pos.dtype)
         kc = jax.lax.dynamic_update_slice(
             carry["k"], k.astype(carry["k"].dtype), (zero, pos, zero, zero))
@@ -189,6 +224,16 @@ class SelfAttentionLayer(Layer):
         q = split_heads(x @ params["Wq"] + params["bq"], self.n_heads)
         k = split_heads(x @ params["Wk"] + params["bk"], self.n_heads)
         v = split_heads(x @ params["Wv"] + params["bv"], self.n_heads)
+        if self.rope:
+            if self.seq_axis is not None:
+                # inside shard_map each chip holds global timesteps
+                # [idx*T_local, (idx+1)*T_local)
+                off = jax.lax.axis_index(self.seq_axis) * q.shape[1]
+            else:
+                off = 0
+            positions = off + jnp.arange(q.shape[1])
+            q = rope(q, positions, self.rope_theta)
+            k = rope(k, positions, self.rope_theta)
         if self.seq_axis is not None:
             from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
 

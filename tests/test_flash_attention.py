@@ -322,3 +322,43 @@ def test_sample_sequence_both_families():
     # greedy sampling is deterministic
     again = sample_sequence(tfm, prompt, steps=5, temperature=0.0)
     np.testing.assert_array_equal(greedy, again)
+
+
+def test_rope_invariants_and_gradcheck():
+    """RoPE: rotation preserves pair norms, position 0 is identity, scores
+    depend on RELATIVE position; and the rope'd attention layer passes the
+    central-difference gradient check (f64)."""
+    from deeplearning4j_tpu.nn.layers.attention import rope
+
+    x = _rand((1, 8, 2, 16), 0)
+    r = rope(x, jnp.arange(8))
+    # norm preserved per rotated pair block
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(r), axis=-1), rtol=1e-5)
+    # position 0 untouched
+    np.testing.assert_allclose(np.asarray(r[:, 0]), np.asarray(x[:, 0]),
+                               rtol=1e-6)
+    # relative property: <rope(q,p1), rope(k,p2)> == <rope(q,p1+s), rope(k,p2+s)>
+    q, k = _rand((1, 1, 1, 16), 1), _rand((1, 1, 1, 16), 2)
+    def score(qp, kp):
+        return float(jnp.sum(rope(q, jnp.array([qp])) * rope(k, jnp.array([kp]))))
+    np.testing.assert_allclose(score(3, 5), score(10, 12), rtol=1e-5)
+
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(8)
+         .updater("sgd", learning_rate=0.05).list()
+         .layer(SelfAttentionLayer(n_in=6, n_out=6, n_heads=2, causal=True,
+                                   rope=True))
+         .layer(RnnOutputLayer(n_in=6, n_out=3)).build())).init(
+             dtype=jnp.float64)
+    rs = np.random.RandomState(9)
+    x = rs.randn(2, 5, 6)
+    y = np.eye(3)[rs.randint(0, 3, (2, 5))]
+    assert check_gradients(net, x, y, max_params_per_array=24)
