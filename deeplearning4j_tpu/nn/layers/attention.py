@@ -83,20 +83,38 @@ def dot_product_attention(
     kernel of ring attention (blockwise causal masking by global position).
     Accumulates in float32 regardless of input dtype (MXU-friendly inputs,
     stable softmax).
+
+    Grouped-query attention: when q carries MORE heads than k/v
+    (H = G * H_kv) the contraction shares each KV head across its G query
+    heads WITHOUT materializing an expanded K/V — the bandwidth this mode
+    exists to save.
     """
     d = q.shape[-1]
+    hq, hkv = q.shape[2], k.shape[2]
     acc = jnp.promote_types(q.dtype, jnp.float32)   # f32 accumulate, f64 for gradchecks
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(acc)
+    grouped = hq != hkv
+    if grouped:
+        if hq % hkv:
+            raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+        qg = q.reshape(q.shape[0], q.shape[1], hkv, hq // hkv, d)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(acc)
+    else:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(acc)
     scores = scores / jnp.sqrt(jnp.asarray(d, acc))
     neg = jnp.asarray(-1e30, acc)
+    head_dims = (None,) * (scores.ndim - 3)   # axes between batch and [q,k]
     if causal:
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         cm = qpos[:, None] >= kpos[None, :]
-        scores = jnp.where(cm[None, None, :, :], scores, neg)
+        scores = jnp.where(cm[(None,) + head_dims], scores, neg)
     if mask is not None:
-        scores = jnp.where(mask[:, None, None, :].astype(bool), scores, neg)
+        idx = (slice(None),) + head_dims + (None, slice(None))
+        scores = jnp.where(mask[idx].astype(bool), scores, neg)
     w = jax.nn.softmax(scores, axis=-1)
+    if grouped:
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", w.astype(v.dtype), v)
+        return o.reshape(q.shape[0], q.shape[1], hq, d)
     return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
 
 
@@ -131,6 +149,11 @@ class SelfAttentionLayer(Layer):
     # ring/Ulysses sequence-parallel paths (global shard offsets)
     rope: bool = False
     rope_theta: float = 10000.0
+    # grouped-query attention: project K/V to this many heads (must divide
+    # n_heads) and share each KV head across n_heads/n_kv_heads query
+    # heads.  Shrinks the KV projections AND the streaming cache by the
+    # same factor — the decode-bandwidth win; None = standard MHA
+    n_kv_heads: Optional[int] = None
 
     def setup(self, input_type: InputType) -> "SelfAttentionLayer":
         upd = {}
@@ -143,16 +166,31 @@ class SelfAttentionLayer(Layer):
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.n_out, input_type.timesteps)
 
+    @property
+    def _kv_heads(self) -> int:
+        return self.n_heads if self.n_kv_heads is None else self.n_kv_heads
+
+    def _expand_kv(self, x: jax.Array) -> jax.Array:
+        """[B, T, Hkv, D] -> [B, T, H, D]: share each KV head across its
+        query-head group (GQA)."""
+        groups = self.n_heads // self._kv_heads
+        return x if groups == 1 else jnp.repeat(x, groups, axis=2)
+
     def init(self, key, dtype=jnp.float32):
         if self.n_out % self.n_heads:
             raise ValueError(
                 f"n_out={self.n_out} not divisible by n_heads={self.n_heads}")
+        if self._kv_heads < 1 or self.n_heads % self._kv_heads:
+            raise ValueError(
+                f"n_kv_heads={self.n_kv_heads} must be a positive divisor "
+                f"of n_heads={self.n_heads}")
+        kv_out = self._kv_heads * (self.n_out // self.n_heads)
         ks = jax.random.split(key, 4)
         p: Dict[str, jax.Array] = {}
         for name, k, (fi, fo) in (
             ("Wq", ks[0], (self.n_in, self.n_out)),
-            ("Wk", ks[1], (self.n_in, self.n_out)),
-            ("Wv", ks[2], (self.n_in, self.n_out)),
+            ("Wk", ks[1], (self.n_in, kv_out)),
+            ("Wv", ks[2], (self.n_in, kv_out)),
             ("Wo", ks[3], (self.n_out, self.n_out)),
         ):
             p[name] = initializers.init(self.weight_init, k, (fi, fo), dtype)
@@ -165,7 +203,8 @@ class SelfAttentionLayer(Layer):
         ``stateMap``, ``BaseRecurrentLayer.java``).  Static ``max_cache``
         length; ``pos`` counts filled timesteps."""
         d_head = self.n_out // self.n_heads
-        shape = (batch, self.max_cache, self.n_heads, d_head)
+        # GQA caches store the UNEXPANDED kv heads — the decode-memory win
+        shape = (batch, self.max_cache, self._kv_heads, d_head)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
                 "pos": jnp.zeros((), jnp.int32)}
 
@@ -195,8 +234,8 @@ class SelfAttentionLayer(Layer):
                 f"mask={'set' if mask is not None else None}")
         x = self.maybe_dropout(x, train=train, rng=rng)
         q = split_heads(x @ params["Wq"] + params["bq"], self.n_heads)
-        k = split_heads(x @ params["Wk"] + params["bk"], self.n_heads)
-        v = split_heads(x @ params["Wv"] + params["bv"], self.n_heads)
+        k = split_heads(x @ params["Wk"] + params["bk"], self._kv_heads)
+        v = split_heads(x @ params["Wv"] + params["bv"], self._kv_heads)
         t_new = q.shape[1]
         pos = carry["pos"]
         if self.rope:
@@ -213,6 +252,8 @@ class SelfAttentionLayer(Layer):
         # (kpos > qpos).  Overflow past max_cache is a hard error, enforced
         # host-side by rnn_time_step (dynamic_update_slice would clamp the
         # write and silently relocate keys); see cache_overflow().
+        # grouped contraction over the UNEXPANDED cache — the decode-
+        # bandwidth win GQA exists for
         o = dot_product_attention(q, kc.astype(q.dtype), vc.astype(q.dtype),
                                   causal=True, q_offset=pos, k_offset=0)
         y = merge_heads(o) @ params["Wo"] + params["bo"]
@@ -222,8 +263,8 @@ class SelfAttentionLayer(Layer):
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         x = self.maybe_dropout(x, train=train, rng=rng)
         q = split_heads(x @ params["Wq"] + params["bq"], self.n_heads)
-        k = split_heads(x @ params["Wk"] + params["bk"], self.n_heads)
-        v = split_heads(x @ params["Wv"] + params["bv"], self.n_heads)
+        k = split_heads(x @ params["Wk"] + params["bk"], self._kv_heads)
+        v = split_heads(x @ params["Wv"] + params["bv"], self._kv_heads)
         if self.rope:
             if self.seq_axis is not None:
                 # inside shard_map each chip holds global timesteps
@@ -237,7 +278,9 @@ class SelfAttentionLayer(Layer):
         if self.seq_axis is not None:
             from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
 
-            o = ring_attention(q, k, v, mask, axis_name=self.seq_axis,
+            # the ring fold contracts matching heads; expand GQA kv here
+            o = ring_attention(q, self._expand_kv(k), self._expand_kv(v),
+                               mask, axis_name=self.seq_axis,
                                causal=self.causal)
         else:
             o = None
@@ -247,8 +290,10 @@ class SelfAttentionLayer(Layer):
                 helper = get_helper("attention")
                 if helper is not None and helper.supports(q.shape[1],
                                                           q.shape[3]):
-                    o = helper.attend(q, k, v, causal=self.causal)
+                    o = helper.attend(q, self._expand_kv(k),
+                                      self._expand_kv(v), causal=self.causal)
             if o is None:
+                # grouped contraction: no KV expansion materialized
                 o = dot_product_attention(q, k, v, causal=self.causal,
                                           mask=mask)
         y = merge_heads(o) @ params["Wo"] + params["bo"]

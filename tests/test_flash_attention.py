@@ -362,3 +362,87 @@ def test_rope_invariants_and_gradcheck():
     x = rs.randn(2, 5, 6)
     y = np.eye(3)[rs.randint(0, 3, (2, 5))]
     assert check_gradients(net, x, y, max_params_per_array=24)
+
+
+def test_gqa_shapes_and_streaming_equivalence():
+    """Grouped-query attention: KV projections and the streaming cache
+    shrink to n_kv_heads, outputs stay [B, T, F], and streaming decode
+    still matches the full forward exactly.  n_kv_heads == n_heads
+    degenerates to standard MHA."""
+    import dataclasses
+
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    layer = SelfAttentionLayer(n_in=16, n_out=16, n_heads=4, n_kv_heads=2,
+                               causal=True, rope=True)
+    params = layer.init(jax.random.PRNGKey(0))
+    assert params["Wk"].shape == (16, 8)        # 2 kv heads x d_head 4
+    assert params["Wv"].shape == (16, 8)
+    assert params["Wq"].shape == (16, 16)
+    cache = layer.init_cache(batch=2)
+    assert cache["k"].shape == (2, layer.max_cache, 2, 4)
+
+    x = _rand((2, 6, 16), 1)
+    full, _ = layer.apply(params, {}, x)
+    carry = layer.init_cache(batch=2)
+    for t in range(6):
+        y, _, carry = layer.apply_with_carry(params, {}, x[:, t:t + 1], carry)
+        np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=1e-5, err_msg=f"t={t}")
+
+    # invalid grouping refuses at init
+    bad = SelfAttentionLayer(n_in=16, n_out=16, n_heads=4, n_kv_heads=3)
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        bad.init(jax.random.PRNGKey(0))
+
+    # degenerate case: explicit n_kv_heads == n_heads matches default MHA
+    mha = SelfAttentionLayer(n_in=16, n_out=16, n_heads=4, causal=True)
+    gqa4 = dataclasses.replace(mha, n_kv_heads=4)
+    p = mha.init(jax.random.PRNGKey(1))
+    y1, _ = mha.apply(p, {}, x)
+    y2, _ = gqa4.apply(p, {}, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+
+def test_gqa_gradcheck():
+    """Central-difference gradient check through a GQA layer (f64)."""
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(10)
+         .updater("sgd", learning_rate=0.05).list()
+         .layer(SelfAttentionLayer(n_in=8, n_out=8, n_heads=4, n_kv_heads=2,
+                                   causal=True, rope=True))
+         .layer(RnnOutputLayer(n_in=8, n_out=3)).build())).init(
+             dtype=jnp.float64)
+    rs = np.random.RandomState(11)
+    x = rs.randn(2, 4, 8)
+    y = np.eye(3)[rs.randint(0, 3, (2, 4))]
+    assert check_gradients(net, x, y, max_params_per_array=24)
+
+
+def test_gqa_zero_kv_heads_rejected():
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    with pytest.raises(ValueError, match="positive divisor"):
+        SelfAttentionLayer(n_in=8, n_out=8, n_heads=4,
+                           n_kv_heads=0).init(jax.random.PRNGKey(0))
+
+
+def test_grouped_dot_product_matches_expanded():
+    """The grouped contraction equals attention over explicitly repeated
+    KV heads (with causal + padding mask engaged)."""
+    q = _rand((2, 8, 4, 16), 0)
+    k = _rand((2, 8, 2, 16), 1)
+    v = _rand((2, 8, 2, 16), 2)
+    m = jnp.asarray(np.array([[1] * 8, [1] * 5 + [0] * 3], np.float32))
+    grouped = dot_product_attention(q, k, v, causal=True, mask=m)
+    expanded = dot_product_attention(
+        q, jnp.repeat(k, 2, axis=2), jnp.repeat(v, 2, axis=2),
+        causal=True, mask=m)
+    np.testing.assert_allclose(np.asarray(grouped), np.asarray(expanded),
+                               rtol=1e-5, atol=1e-6)
