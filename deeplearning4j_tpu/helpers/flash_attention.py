@@ -89,10 +89,25 @@ def _dot_f32(a, b, trans_a=False, trans_b=False):
         preferred_element_type=jnp.float32)
 
 
-def _causal_mask(s, qi, ki, block_q, block_k):
+def _causal_mask(s, qi, ki, block_q, block_k, window=None):
+    """Causal (and optionally sliding-window banded) score masking by
+    global position: keep kpos in [qpos - window + 1, qpos]."""
     qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     kpos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(qpos >= kpos, s, NEG_INF)
+    keep = qpos >= kpos
+    if window is not None:
+        keep &= kpos > qpos - window
+    return jnp.where(keep, s, NEG_INF)
+
+
+def _block_live(qi, ki, block_q, block_k, causal, window):
+    """Does block (qi, ki) intersect the (banded) causal region?"""
+    if not causal:
+        return True
+    live = qi * block_q + block_q - 1 >= ki * block_k
+    if window is not None:
+        live &= ki * block_k + block_k - 1 > qi * block_q - window
+    return live
 
 
 # ---------------------------------------------------------------------------
@@ -100,7 +115,8 @@ def _causal_mask(s, qi, ki, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k):
+                m_scr, l_scr, acc_scr, *, scale, causal, window,
+                block_q, block_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -111,14 +127,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # causal: blocks strictly above the diagonal contribute nothing
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    # blocks outside the (banded) causal region contribute nothing
+    run = _block_live(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
         s = _dot_f32(q_ref[:], k_ref[:], trans_b=True) * scale
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, window)
         m_prev = m_scr[:, :1]                      # [bq, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
@@ -139,34 +155,53 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[:] = m_scr[:] + jnp.log(safe)
 
 
-def _kv_index(causal, block_q, block_k):
-    """K/V block index for q-major grids.  For causal, blocks strictly above
-    the diagonal clamp to the diagonal block: the index stops changing, so
-    the Pallas pipeline skips their HBM->VMEM copies entirely (the compute
-    for those steps is already skipped by the kernels' ``run`` predicate)."""
+def _kv_index(causal, block_q, block_k, window=None):
+    """K/V block index for q-major grids.  Blocks outside the (banded)
+    causal region clamp to the nearest live block: the index stops
+    changing, so the Pallas pipeline skips their HBM->VMEM copies entirely
+    (the compute for those steps is already skipped by the kernels'
+    ``run`` predicate)."""
     if not causal:
         return lambda b, qi, ki: (b, ki, 0)
-    return lambda b, qi, ki: (
-        b, jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k), 0)
+
+    def idx(b, qi, ki):
+        hi = (qi * block_q + block_q - 1) // block_k
+        k = jnp.minimum(ki, hi)
+        if window is not None:
+            lo = jnp.maximum(0, (qi * block_q - window + 1) // block_k)
+            k = jnp.maximum(k, lo)
+        return (b, k, 0)
+
+    return idx
 
 
-def _q_index(causal, block_q, block_k):
-    """Q-side block index for the k-major (dk/dv) grid: causal q blocks
-    strictly above the diagonal clamp forward to the first valid one."""
+def _q_index(causal, block_q, block_k, window=None):
+    """Q-side block index for the k-major (dk/dv) grid: q blocks outside
+    the band clamp to the nearest live one."""
     if not causal:
         return lambda b, ki, qi: (b, qi, 0)
-    return lambda b, ki, qi: (
-        b, jnp.maximum(qi, (ki * block_k) // block_q), 0)
+
+    def idx(b, ki, qi):
+        lo = (ki * block_k) // block_q
+        q = jnp.maximum(qi, lo)
+        if window is not None:
+            hi = (ki * block_k + block_k - 1 + window - 1) // block_q
+            q = jnp.minimum(q, hi)
+        return (b, q, 0)
+
+    return idx
 
 
-def _fwd_call(q, k, v, *, scale, causal, block_q, block_k, interpret):
+def _fwd_call(q, k, v, *, scale, causal, window, block_q, block_k,
+              interpret):
     """q,k,v: [BH, T, D] (D already lane-padded). Returns (o, lse[BH,T,128])."""
     bh, t, d = q.shape
     nq, nk = t // block_q, t // block_k
     grid = (bh, nq, nk)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             block_q=block_q, block_k=block_k)
-    kv_idx = _kv_index(causal, block_q, block_k)
+                             window=window, block_q=block_q,
+                             block_k=block_k)
+    kv_idx = _kv_index(causal, block_q, block_k, window)
     return pl.pallas_call(
         kern,
         grid=grid,
@@ -199,7 +234,7 @@ def _fwd_call(q, k, v, *, scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k):
+               dq_scr, *, scale, causal, window, block_q, block_k):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -208,13 +243,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    run = _block_live(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
         s = _dot_f32(q_ref[:], k_ref[:], trans_b=True) * scale
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, window)
         p = jnp.exp(s - lse_ref[:, :1])                      # [bq, bk]
         dp = _dot_f32(do_ref[:], v_ref[:], trans_b=True)     # [bq, bk]
         ds = p * (dp - di_ref[:, :1])
@@ -227,7 +262,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, block_q, block_k):
+                *, scale, causal, window, block_q, block_k):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -237,13 +272,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = (qi * block_q + block_q - 1 >= ki * block_k) if causal else True
+    run = _block_live(qi, ki, block_q, block_k, causal, window)
 
     @pl.when(run)
     def _step():
         s = _dot_f32(q_ref[:], k_ref[:], trans_b=True) * scale
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k)
+            s = _causal_mask(s, qi, ki, block_q, block_k, window)
         p = jnp.exp(s - lse_ref[:, :1])                      # [bq, bk] f32
         pv = p.astype(do_ref.dtype)
         dv_scr[:] += _dot_f32(pv, do_ref[:], trans_a=True)   # [bk, D]
@@ -257,8 +292,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
-              interpret):
+def _bwd_call(q, k, v, o, lse, do, *, scale, causal, window, block_q,
+              block_k, interpret):
     bh, t, d = q.shape
     nq, nk = t // block_q, t // block_k
     # delta_i = rowsum(dO * O): cheap elementwise+reduce, leave it to XLA,
@@ -267,13 +302,14 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     di = jnp.broadcast_to(di[:, :, None], (bh, t, LANES))
 
     qspec = pl.BlockSpec((None, block_q, d), lambda b, qi, ki: (b, qi, 0))
-    kv_idx = _kv_index(causal, block_q, block_k)
+    kv_idx = _kv_index(causal, block_q, block_k, window)
     kspec = pl.BlockSpec((None, block_k, d), kv_idx)
     rowq = pl.BlockSpec((None, block_q, LANES), lambda b, qi, ki: (b, qi, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q,
+                          block_k=block_k),
         grid=(bh, nq, nk),
         in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
         out_specs=qspec,
@@ -285,13 +321,14 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
     )(q, k, v, do, lse, di)
 
     # k-major grid: swap the roles of the two minor axes
-    q_idx = _q_index(causal, block_q, block_k)
+    q_idx = _q_index(causal, block_q, block_k, window)
     qspec2 = pl.BlockSpec((None, block_q, d), q_idx)
     kspec2 = pl.BlockSpec((None, block_k, d), lambda b, ki, qi: (b, ki, 0))
     rowq2 = pl.BlockSpec((None, block_q, LANES), q_idx)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q,
+                          block_k=block_k),
         grid=(bh, nk, nq),
         in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
         out_specs=[kspec2, kspec2],
@@ -310,23 +347,25 @@ def _bwd_call(q, k, v, o, lse, do, *, scale, causal, block_q, block_k,
 # public op: [B, T, H, D] in, custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, window, block_q, block_k, interpret):
+    o, _ = _flash_fwd(q, k, v, scale, causal, window, block_q, block_k,
+                      interpret)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    o, lse = _fwd_call(q, k, v, scale=scale, causal=causal,
+def _flash_fwd(q, k, v, scale, causal, window, block_q, block_k,
+               interpret):
+    o, lse = _fwd_call(q, k, v, scale=scale, causal=causal, window=window,
                        block_q=block_q, block_k=block_k, interpret=interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, window, block_q, block_k, interpret, res, g):
     q, k, v, o, lse = res
     dq, dk, dv = _bwd_call(q, k, v, o, lse, g, scale=scale,
-                           causal=causal, block_q=block_q, block_k=block_k,
-                           interpret=interpret)
+                           causal=causal, window=window, block_q=block_q,
+                           block_k=block_k, interpret=interpret)
     return dq, dk, dv
 
 
@@ -335,6 +374,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
+                    window: Optional[int] = None,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
@@ -345,8 +385,15 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sweet spot (512/1024) is chosen.  D is zero-padded to a 128-lane
     multiple internally (exact, including gradients).  Softmax scale is
     1/sqrt(true D).
+
+    ``window`` (requires ``causal``) bands the attention to the last
+    ``window`` positions per query; blocks outside the band skip both
+    compute and their HBM fetches (two-sided index clamping).
     """
     b, t, h, d = q.shape
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1")
     picked = pick_blocks(t, block_q, block_k)
     if picked is None:
         raise ValueError(
@@ -364,8 +411,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if dp:
         pad = ((0, 0), (0, 0), (0, 0), (0, dp))
         q, k, v = jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad)
-    o = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, block_q, block_k,
-               interpret)
+    o = _flash(to_bh(q), to_bh(k), to_bh(v), scale, causal, window,
+               block_q, block_k, interpret)
     o = o.reshape(b, h, t, d + dp).transpose(0, 2, 1, 3)
     return o[..., :d] if dp else o
 
@@ -396,5 +443,6 @@ class FlashAttentionHelper:
             return False
         return supports(t, d)
 
-    def attend(self, q, k, v, *, causal: bool = False) -> jax.Array:
-        return flash_attention(q, k, v, causal=causal)
+    def attend(self, q, k, v, *, causal: bool = False,
+               window: Optional[int] = None) -> jax.Array:
+        return flash_attention(q, k, v, causal=causal, window=window)

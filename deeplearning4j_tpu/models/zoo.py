@@ -337,7 +337,9 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         seq_axis: Optional[str] = None,
                         remat: bool = False,
                         compute_dtype: Optional[str] = None,
-                        rope: bool = True) -> MultiLayerNetwork:
+                        rope: bool = True,
+                        n_kv_heads: Optional[int] = None,
+                        window: Optional[int] = None) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
@@ -374,7 +376,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
             LayerNorm(n_in=d_model),
             SelfAttentionLayer(n_in=d_model, n_out=d_model,
                                n_heads=n_heads, causal=True,
-                               seq_axis=seq_axis, rope=rope),
+                               seq_axis=seq_axis, rope=rope,
+                               n_kv_heads=n_kv_heads, window=window),
         )))
         b.layer(ResidualBlock(remat=remat, layers=(
             LayerNorm(n_in=d_model),

@@ -72,6 +72,7 @@ def dot_product_attention(
     v: jax.Array,
     *,
     causal: bool = False,
+    window: Optional[int] = None,
     mask: Optional[jax.Array] = None,
     q_offset: int | jax.Array = 0,
     k_offset: int | jax.Array = 0,
@@ -89,6 +90,9 @@ def dot_product_attention(
     heads WITHOUT materializing an expanded K/V — the bandwidth this mode
     exists to save.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1")
     d = q.shape[-1]
     hq, hkv = q.shape[2], k.shape[2]
     acc = jnp.promote_types(q.dtype, jnp.float32)   # f32 accumulate, f64 for gradchecks
@@ -107,6 +111,9 @@ def dot_product_attention(
         qpos = q_offset + jnp.arange(q.shape[1])
         kpos = k_offset + jnp.arange(k.shape[1])
         cm = qpos[:, None] >= kpos[None, :]
+        if window is not None:
+            # sliding window: keep kpos in [qpos - window + 1, qpos]
+            cm &= kpos[None, :] > qpos[:, None] - window
         scores = jnp.where(cm[(None,) + head_dims], scores, neg)
     if mask is not None:
         idx = (slice(None),) + head_dims + (None, slice(None))
@@ -154,6 +161,10 @@ class SelfAttentionLayer(Layer):
     # heads.  Shrinks the KV projections AND the streaming cache by the
     # same factor — the decode-bandwidth win; None = standard MHA
     n_kv_heads: Optional[int] = None
+    # sliding-window (banded causal) attention: each query attends only the
+    # last `window` positions.  Bounded per-token cost on every path; the
+    # flash kernel skips out-of-band blocks' compute AND HBM fetches
+    window: Optional[int] = None
 
     def setup(self, input_type: InputType) -> "SelfAttentionLayer":
         upd = {}
@@ -184,6 +195,9 @@ class SelfAttentionLayer(Layer):
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must be a positive divisor "
                 f"of n_heads={self.n_heads}")
+        if self.window is not None and (not self.causal or self.window < 1):
+            raise ValueError(
+                f"window={self.window} requires causal=True and window >= 1")
         kv_out = self._kv_heads * (self.n_out // self.n_heads)
         ks = jax.random.split(key, 4)
         p: Dict[str, jax.Array] = {}
@@ -255,7 +269,8 @@ class SelfAttentionLayer(Layer):
         # grouped contraction over the UNEXPANDED cache — the decode-
         # bandwidth win GQA exists for
         o = dot_product_attention(q, kc.astype(q.dtype), vc.astype(q.dtype),
-                                  causal=True, q_offset=pos, k_offset=0)
+                                  causal=True, window=self.window,
+                                  q_offset=pos, k_offset=0)
         y = merge_heads(o) @ params["Wo"] + params["bo"]
         new_carry = {"k": kc, "v": vc, "pos": pos + t_new}
         return activations.get(self.activation)(y), state, new_carry
@@ -278,10 +293,10 @@ class SelfAttentionLayer(Layer):
         if self.seq_axis is not None:
             from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
 
-            # the ring fold contracts matching heads; expand GQA kv here
-            o = ring_attention(q, self._expand_kv(k), self._expand_kv(v),
-                               mask, axis_name=self.seq_axis,
-                               causal=self.causal)
+            # the ring fold contracts GQA heads directly: the rotating K/V
+            # keeps H_kv heads, preserving the ICI/memory shrink
+            o = ring_attention(q, k, v, mask, axis_name=self.seq_axis,
+                               causal=self.causal, window=self.window)
         else:
             o = None
             if self.flash and mask is None and q.dtype != jnp.float64:
@@ -291,10 +306,11 @@ class SelfAttentionLayer(Layer):
                 if helper is not None and helper.supports(q.shape[1],
                                                           q.shape[3]):
                     o = helper.attend(q, self._expand_kv(k),
-                                      self._expand_kv(v), causal=self.causal)
+                                      self._expand_kv(v), causal=self.causal,
+                                      window=self.window)
             if o is None:
                 # grouped contraction: no KV expansion materialized
                 o = dot_product_attention(q, k, v, causal=self.causal,
-                                          mask=mask)
+                                          window=self.window, mask=mask)
         y = merge_heads(o) @ params["Wo"] + params["bo"]
         return activations.get(self.activation)(y), state

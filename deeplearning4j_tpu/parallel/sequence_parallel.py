@@ -44,7 +44,7 @@ _NEG = -1e30
 
 
 def ring_attention(q, k, v, mask=None, *, axis_name: str,
-                   causal: bool = False):
+                   causal: bool = False, window: Optional[int] = None):
     """Blockwise ring attention over one mesh axis.
 
     Must be called inside ``shard_map``; ``q/k/v`` are local sequence shards
@@ -54,9 +54,17 @@ def ring_attention(q, k, v, mask=None, *, axis_name: str,
     local shard of the exact attention output — numerically identical (up to
     fp associativity) to full attention on the gathered sequence.
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1")
     n_shards = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
+    hkv = k.shape[2]
+    grouped = hkv != h
+    if grouped and h % hkv:
+        raise ValueError(f"q heads {h} not a multiple of kv heads {hkv}")
+    groups = h // hkv
     q_off = idx * t_local
     qpos = q_off + jnp.arange(t_local)
 
@@ -75,10 +83,24 @@ def ring_attention(q, k, v, mask=None, *, axis_name: str,
         the rotation; globally it is shard (idx - s) mod n_shards)."""
         src = (idx - s) % n_shards
         kpos = src * t_local + jnp.arange(t_local)
-        scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", qf, k_cur.astype(acc)) * scale
+        if grouped:
+            # GQA: contract each KV head against its query-head group
+            # directly — the rotating K/V stays at H_kv heads, so ICI
+            # traffic and per-chip K/V memory keep the GQA shrink.
+            # (hkv, g) flattens in the same head order as jnp.repeat.
+            qg = qf.reshape(b, t_local, hkv, groups, d)
+            scores = jnp.einsum(
+                "bqhgd,bkhd->bhgqk", qg, k_cur.astype(acc)
+            ).reshape(b, h, t_local, t_local) * scale
+        else:
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", qf, k_cur.astype(acc)) * scale
         if causal:
             blk_mask = qpos[:, None] >= kpos[None, :]       # [Tq, Tk]
+            if window is not None:
+                # sliding window by GLOBAL position, same band as the
+                # local paths: kpos in [qpos - window + 1, qpos]
+                blk_mask &= kpos[None, :] > qpos[:, None] - window
             valid = blk_mask[None, None]
         else:
             valid = jnp.ones((1, 1, t_local, t_local), bool)
@@ -90,8 +112,13 @@ def ring_attention(q, k, v, mask=None, *, axis_name: str,
         alpha = jnp.exp(m - m_new)
         p = jnp.where(valid, jnp.exp(scores - m_new[..., None]), 0.0)
         l = l * alpha + jnp.sum(p, axis=-1)
-        o = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(acc))
+        if grouped:
+            pg = p.reshape(b, hkv, groups, t_local, t_local)
+            pv = jnp.einsum("bhgqk,bkhd->bhgqd", pg, v_cur.astype(acc)
+                            ).reshape(b, h, t_local, d)
+        else:
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p, v_cur.astype(acc))
+        o = o * alpha[..., None] + pv
         return o, l, m_new
 
     # step 0 folds the local block with no communication; remaining steps

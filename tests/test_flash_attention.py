@@ -151,9 +151,9 @@ def test_helper_seam_routing(monkeypatch):
     calls = []
 
     class Spy(fa.FlashAttentionHelper):
-        def attend(self, q, k, v, *, causal=False):
+        def attend(self, q, k, v, **kw):
             calls.append(q.shape)
-            return super().attend(q, k, v, causal=causal)
+            return super().attend(q, k, v, **kw)
 
     helpers.register_helper("attention", Spy(allow_interpret=True))
     try:
@@ -446,3 +446,135 @@ def test_grouped_dot_product_matches_expanded():
         causal=True, mask=m)
     np.testing.assert_allclose(np.asarray(grouped), np.asarray(expanded),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("window", [32, 100, 500])
+def test_sliding_window_parity(window):
+    """Windowed flash == windowed einsum attention, fwd and grads, for
+    windows smaller than, straddling, and larger than the block sizes."""
+    q, k, v = (_rand((2, 256, 2, 32), s) for s in (0, 1, 2))
+    ref = dot_product_attention(q, k, v, causal=True, window=window)
+    out = fa.flash_attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        dot_product_attention(q, k, v, causal=True, window=window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda q, k, v: jnp.sum(
+        fa.flash_attention(q, k, v, causal=True, window=window) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("qkv", gr, gf):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-9
+        np.testing.assert_allclose(np.asarray(b) / scale, np.asarray(a) / scale,
+                                   atol=2e-5, err_msg=f"d{name}")
+
+
+def test_sliding_window_layer_and_streaming():
+    """Windowed attention layer: streaming decode matches the full forward
+    (the band is position-based, so the cache path inherits it), and the
+    config round-trips."""
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    layer = SelfAttentionLayer(n_in=12, n_out=12, n_heads=2, causal=True,
+                               window=3, rope=True)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = _rand((2, 8, 12), 1)
+    full, _ = layer.apply(params, {}, x)
+    carry = layer.init_cache(batch=2)
+    for t in range(8):
+        y, _, carry = layer.apply_with_carry(params, {}, x[:, t:t + 1], carry)
+        np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=1e-5, err_msg=f"t={t}")
+    back = SelfAttentionLayer.from_dict(layer.to_dict())
+    assert back.window == 3
+
+    with pytest.raises(ValueError, match="window"):
+        SelfAttentionLayer(n_in=12, n_out=12, n_heads=2, causal=False,
+                           window=3).init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="window"):
+        fa.flash_attention(_rand((1, 128, 2, 32)), _rand((1, 128, 2, 32)),
+                           _rand((1, 128, 2, 32)), causal=False, window=4)
+
+
+def test_sliding_window_ring_matches_exact():
+    """Ring attention with a window == exact windowed attention (the band
+    uses global positions, so shard offsets must line up)."""
+    import functools
+
+    from jax.sharding import Mesh
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.backend import device as backend
+    from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
+
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 16, 2, 4)), jnp.float32)
+               for _ in range(3))
+    devs = np.array(jax.devices()[:4]).reshape(1, 1, 4)
+    mesh = Mesh(devs, (backend.AXIS_DATA, backend.AXIS_MODEL, backend.AXIS_SEQ))
+    spec = P(None, backend.AXIS_SEQ)
+    got = shard_map(
+        functools.partial(ring_attention, axis_name=backend.AXIS_SEQ,
+                          causal=True, window=5),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+    want = dot_product_attention(q, k, v, causal=True, window=5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_window_flash_and_ring_paths(interpret_helper):
+    """GQA combined with window through every path: grouped einsum, the
+    flash helper's _expand_kv branch (interpret), and the grouped ring
+    fold — all equal to attention over explicitly repeated KV heads."""
+    import dataclasses
+    import functools
+
+    from jax.sharding import Mesh
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.backend import device as backend
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
+
+    # layer level: flash helper engaged (interpret) vs flash off — the
+    # expand branch must agree with the grouped einsum branch
+    layer = SelfAttentionLayer(n_in=16, n_out=16, n_heads=4, n_kv_heads=2,
+                               causal=True, window=40)
+    params = layer.init(jax.random.PRNGKey(0))
+    x = _rand((2, 128, 16), 3)
+    y_flash, _ = layer.apply(params, {}, x)
+    y_plain, _ = dataclasses.replace(layer, flash=False).apply(params, {}, x)
+    np.testing.assert_allclose(np.asarray(y_flash), np.asarray(y_plain),
+                               atol=3e-5)
+
+    # ring fold: grouped + windowed vs exact grouped attention
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((1, 16, 4, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 16, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 16, 2, 8)), jnp.float32)
+    devs = np.array(jax.devices()[:4]).reshape(1, 1, 4)
+    mesh = Mesh(devs, (backend.AXIS_DATA, backend.AXIS_MODEL, backend.AXIS_SEQ))
+    spec = P(None, backend.AXIS_SEQ)
+    got = shard_map(
+        functools.partial(ring_attention, axis_name=backend.AXIS_SEQ,
+                          causal=True, window=6),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+    )(q, k, v)
+    want = dot_product_attention(q, k, v, causal=True, window=6)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_window_without_causal_raises_everywhere():
+    """The window-without-causal contract is a loud error on every
+    entry point, not a silent no-op on some."""
+    from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
+
+    q = _rand((1, 128, 2, 16))
+    with pytest.raises(ValueError, match="window"):
+        dot_product_attention(q, q, q, causal=False, window=8)
+    with pytest.raises(ValueError, match="window"):
+        fa.flash_attention(q, q, q, causal=False, window=8)
