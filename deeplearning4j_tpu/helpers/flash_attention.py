@@ -390,10 +390,10 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     ``window`` positions per query; blocks outside the band skip both
     compute and their HBM fetches (two-sided index clamping).
     """
+    from deeplearning4j_tpu.nn.layers.attention import check_window
+
     b, t, h, d = q.shape
-    if window is not None and (not causal or window < 1):
-        raise ValueError(
-            f"window={window} requires causal=True and window >= 1")
+    check_window(causal, window)
     picked = pick_blocks(t, block_q, block_k)
     if picked is None:
         raise ValueError(

@@ -42,6 +42,14 @@ def merge_heads(x: jax.Array) -> jax.Array:
     return x.reshape(b, t, h * d)
 
 
+def check_window(causal: bool, window: Optional[int]) -> None:
+    """Single source of truth for the sliding-window contract: every entry
+    point (flash, einsum, ring, layer config) fails loudly the same way."""
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1")
+
+
 def rope(x: jax.Array, positions: jax.Array,
          theta: float = 10000.0) -> jax.Array:
     """Rotary position embedding on ``[B, T, H, D]`` (RoFormer; public
@@ -90,9 +98,7 @@ def dot_product_attention(
     heads WITHOUT materializing an expanded K/V — the bandwidth this mode
     exists to save.
     """
-    if window is not None and (not causal or window < 1):
-        raise ValueError(
-            f"window={window} requires causal=True and window >= 1")
+    check_window(causal, window)
     d = q.shape[-1]
     hq, hkv = q.shape[2], k.shape[2]
     acc = jnp.promote_types(q.dtype, jnp.float32)   # f32 accumulate, f64 for gradchecks
@@ -162,8 +168,10 @@ class SelfAttentionLayer(Layer):
     # same factor — the decode-bandwidth win; None = standard MHA
     n_kv_heads: Optional[int] = None
     # sliding-window (banded causal) attention: each query attends only the
-    # last `window` positions.  Bounded per-token cost on every path; the
-    # flash kernel skips out-of-band blocks' compute AND HBM fetches
+    # last `window` positions.  The flash kernel skips out-of-band blocks'
+    # compute AND HBM fetches; the einsum/ring/decode paths apply the band
+    # as masking (full score matrices; the decode cache still holds the
+    # whole history — a rolling window cache is known follow-up work)
     window: Optional[int] = None
 
     def setup(self, input_type: InputType) -> "SelfAttentionLayer":
@@ -195,9 +203,7 @@ class SelfAttentionLayer(Layer):
             raise ValueError(
                 f"n_kv_heads={self.n_kv_heads} must be a positive divisor "
                 f"of n_heads={self.n_heads}")
-        if self.window is not None and (not self.causal or self.window < 1):
-            raise ValueError(
-                f"window={self.window} requires causal=True and window >= 1")
+        check_window(self.causal, self.window)
         kv_out = self._kv_heads * (self.n_out // self.n_heads)
         ks = jax.random.split(key, 4)
         p: Dict[str, jax.Array] = {}

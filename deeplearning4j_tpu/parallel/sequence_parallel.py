@@ -54,9 +54,9 @@ def ring_attention(q, k, v, mask=None, *, axis_name: str,
     local shard of the exact attention output — numerically identical (up to
     fp associativity) to full attention on the gathered sequence.
     """
-    if window is not None and (not causal or window < 1):
-        raise ValueError(
-            f"window={window} requires causal=True and window >= 1")
+    from deeplearning4j_tpu.nn.layers.attention import check_window
+
+    check_window(causal, window)
     n_shards = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
