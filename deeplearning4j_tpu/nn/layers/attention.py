@@ -84,6 +84,8 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,
     q_offset: int | jax.Array = 0,
     k_offset: int | jax.Array = 0,
+    q_positions: Optional[jax.Array] = None,
+    k_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Scaled dot-product attention on ``[B, T, H, D]`` tensors.
 
@@ -114,8 +116,12 @@ def dot_product_attention(
     neg = jnp.asarray(-1e30, acc)
     head_dims = (None,) * (scores.ndim - 3)   # axes between batch and [q,k]
     if causal:
-        qpos = q_offset + jnp.arange(q.shape[1])
-        kpos = k_offset + jnp.arange(k.shape[1])
+        # explicit position vectors override the contiguous offset+arange
+        # convention (rolling KV caches store keys out of order)
+        qpos = (q_positions if q_positions is not None
+                else q_offset + jnp.arange(q.shape[1]))
+        kpos = (k_positions if k_positions is not None
+                else k_offset + jnp.arange(k.shape[1]))
         cm = qpos[:, None] >= kpos[None, :]
         if window is not None:
             # sliding window: keep kpos in [qpos - window + 1, qpos]
@@ -169,9 +175,10 @@ class SelfAttentionLayer(Layer):
     n_kv_heads: Optional[int] = None
     # sliding-window (banded causal) attention: each query attends only the
     # last `window` positions.  The flash kernel skips out-of-band blocks'
-    # compute AND HBM fetches; the einsum/ring/decode paths apply the band
-    # as masking (full score matrices; the decode cache still holds the
-    # whole history — a rolling window cache is known follow-up work)
+    # compute AND HBM fetches; the einsum/ring paths apply the band as
+    # masking (full score matrices); streaming decode uses a window-length
+    # ROLLING cache (position-tracked ring buffer) — O(window) memory for
+    # unbounded decode
     window: Optional[int] = None
 
     def setup(self, input_type: InputType) -> "SelfAttentionLayer":
@@ -220,27 +227,43 @@ class SelfAttentionLayer(Layer):
     def init_cache(self, batch: int, dtype=jnp.float32) -> Dict[str, jax.Array]:
         """KV cache for streaming inference (``rnn_time_step`` on
         transformer stacks — the attention analog of the reference's RNN
-        ``stateMap``, ``BaseRecurrentLayer.java``).  Static ``max_cache``
-        length; ``pos`` counts filled timesteps."""
+        ``stateMap``, ``BaseRecurrentLayer.java``).
+
+        Linear mode (no ``window``): ``max_cache`` slots, ``pos`` counts
+        filled timesteps, overflow is a hard error.  Rolling mode
+        (``window`` set): ``window`` slots written modulo, each slot's
+        GLOBAL position tracked in ``kpos`` — unbounded decode length in
+        O(window) memory (out-of-band keys are overwritten exactly when
+        they leave the band)."""
         d_head = self.n_out // self.n_heads
         # GQA caches store the UNEXPANDED kv heads — the decode-memory win
-        shape = (batch, self.max_cache, self._kv_heads, d_head)
-        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
-                "pos": jnp.zeros((), jnp.int32)}
+        length = self.window if self.window is not None else self.max_cache
+        shape = (batch, length, self._kv_heads, d_head)
+        cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+                 "pos": jnp.zeros((), jnp.int32)}
+        if self.window is not None:
+            # sentinel far below any reachable qpos - window bound
+            cache["kpos"] = jnp.full((length,), jnp.iinfo(jnp.int32).min // 2,
+                                     jnp.int32)
+        return cache
 
     @staticmethod
     def cache_overflow(carry, t_new: int) -> bool:
         """Would appending ``t_new`` steps exceed the cache?  Checked
         host-side before dispatch: ``dynamic_update_slice`` CLAMPS an
-        out-of-range start index, which would silently relocate keys."""
+        out-of-range start index, which would silently relocate keys.
+        Rolling (windowed) caches never overflow."""
+        if "kpos" in carry:
+            return False
         return int(carry["pos"]) + t_new > carry["k"].shape[1]
 
     def apply_with_carry(self, params, state, x, carry, *, train=False,
                          rng=None, mask=None):
         """carry=None -> exact full-sequence apply (training and batch
         inference paths are untouched).  With a cache carry: append this
-        call's K/V at ``pos`` and attend the new queries over everything
-        cached so far — O(T_new · pos) per call, the streaming-decode path."""
+        call's K/V and attend the new queries over the cached prefix —
+        O(T_new · pos) per call on linear caches, O(T_new · window) on
+        rolling (windowed) ones."""
         if carry is None:
             y, st = self.apply(params, state, x, train=train, rng=rng,
                                mask=mask)
@@ -258,27 +281,53 @@ class SelfAttentionLayer(Layer):
         v = split_heads(x @ params["Wv"] + params["bv"], self._kv_heads)
         t_new = q.shape[1]
         pos = carry["pos"]
+        new_pos = pos + jnp.arange(t_new, dtype=pos.dtype)
         if self.rope:
             # rotate by GLOBAL position; cached keys are stored rotated
-            new_pos = pos + jnp.arange(t_new)
             q = rope(q, new_pos, self.rope_theta)
             k = rope(k, new_pos, self.rope_theta)
-        zero = jnp.zeros((), pos.dtype)
-        kc = jax.lax.dynamic_update_slice(
-            carry["k"], k.astype(carry["k"].dtype), (zero, pos, zero, zero))
-        vc = jax.lax.dynamic_update_slice(
-            carry["v"], v.astype(carry["v"].dtype), (zero, pos, zero, zero))
-        # causal masking by global position also hides the unfilled tail
-        # (kpos > qpos).  Overflow past max_cache is a hard error, enforced
-        # host-side by rnn_time_step (dynamic_update_slice would clamp the
-        # write and silently relocate keys); see cache_overflow().
-        # grouped contraction over the UNEXPANDED cache — the decode-
-        # bandwidth win GQA exists for
-        o = dot_product_attention(q, kc.astype(q.dtype), vc.astype(q.dtype),
-                                  causal=True, window=self.window,
-                                  q_offset=pos, k_offset=0)
+        if "kpos" in carry:
+            # rolling mode: attend over [old ring buffer || this chunk]
+            # (writing first would clobber keys still in-band for the
+            # chunk's earlier rows), then write the chunk's tail modulo
+            # the window-sized buffer for the next call
+            L = carry["k"].shape[1]
+            k_all = jnp.concatenate(
+                [carry["k"].astype(q.dtype), k.astype(q.dtype)], axis=1)
+            v_all = jnp.concatenate(
+                [carry["v"].astype(q.dtype), v.astype(q.dtype)], axis=1)
+            kpos_all = jnp.concatenate([carry["kpos"], new_pos])
+            o = dot_product_attention(
+                q, k_all, v_all, causal=True, window=self.window,
+                q_positions=new_pos, k_positions=kpos_all)
+            if t_new > L:   # only the last L positions can stay cached
+                k, v, wpos = k[:, -L:], v[:, -L:], new_pos[-L:]
+            else:
+                wpos = new_pos
+            slots = wpos % L   # consecutive positions -> distinct slots
+            kc = carry["k"].at[:, slots].set(k.astype(carry["k"].dtype))
+            vc = carry["v"].at[:, slots].set(v.astype(carry["v"].dtype))
+            kposc = carry["kpos"].at[slots].set(wpos)
+            new_carry = {"k": kc, "v": vc, "pos": pos + t_new, "kpos": kposc}
+        else:
+            zero = jnp.zeros((), pos.dtype)
+            kc = jax.lax.dynamic_update_slice(
+                carry["k"], k.astype(carry["k"].dtype),
+                (zero, pos, zero, zero))
+            vc = jax.lax.dynamic_update_slice(
+                carry["v"], v.astype(carry["v"].dtype),
+                (zero, pos, zero, zero))
+            # causal masking by global position also hides the unfilled
+            # tail (kpos > qpos).  Overflow past max_cache is a hard error,
+            # enforced host-side by rnn_time_step (dynamic_update_slice
+            # would clamp the write and silently relocate keys); see
+            # cache_overflow().  Grouped contraction over the UNEXPANDED
+            # cache — the decode-bandwidth win GQA exists for.
+            o = dot_product_attention(
+                q, kc.astype(q.dtype), vc.astype(q.dtype),
+                causal=True, window=self.window, q_offset=pos, k_offset=0)
+            new_carry = {"k": kc, "v": vc, "pos": pos + t_new}
         y = merge_heads(o) @ params["Wo"] + params["bo"]
-        new_carry = {"k": kc, "v": vc, "pos": pos + t_new}
         return activations.get(self.activation)(y), state, new_carry
 
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):

@@ -578,3 +578,48 @@ def test_window_without_causal_raises_everywhere():
         dot_product_attention(q, q, q, causal=False, window=8)
     with pytest.raises(ValueError, match="window"):
         fa.flash_attention(q, q, q, causal=False, window=8)
+
+
+def test_rolling_window_cache_unbounded_decode():
+    """Windowed layers stream in O(window) memory forever: the ring buffer
+    holds `window` slots, wraps many times, and step-by-step decode still
+    matches the full windowed forward — including a chunked prime that
+    crosses the wrap boundary and a chunk longer than the window."""
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+
+    W = 4
+    layer = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=True,
+                               window=W, rope=True)
+    params = layer.init(jax.random.PRNGKey(0))
+    carry = layer.init_cache(batch=2)
+    assert carry["k"].shape[1] == W          # O(window), not max_cache
+    T = 6 * W
+    x = _rand((2, T, 8), 1)
+    full, _ = layer.apply(params, {}, x)
+    for t in range(T):                        # wraps the buffer 6 times
+        y, _, carry = layer.apply_with_carry(params, {}, x[:, t:t + 1], carry)
+        np.testing.assert_allclose(np.asarray(y[:, 0]), np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=1e-5, err_msg=f"t={t}")
+
+    # chunked feeding: prime with W+3 (crosses a wrap), then 2-token chunks
+    carry = layer.init_cache(batch=2)
+    outs = []
+    y, _, carry = layer.apply_with_carry(params, {}, x[:, :W + 3], carry)
+    outs.append(y)
+    for t0 in range(W + 3, T, 2):
+        y, _, carry = layer.apply_with_carry(params, {}, x[:, t0:t0 + 2], carry)
+        outs.append(y)
+    got = jnp.concatenate(outs, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(full),
+                               rtol=2e-4, atol=1e-5)
+
+    # a single chunk longer than the window (only the tail stays cached)
+    carry = layer.init_cache(batch=2)
+    y, _, carry = layer.apply_with_carry(params, {}, x[:, :3 * W], carry)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(full[:, :3 * W]),
+                               rtol=2e-4, atol=1e-5)
+    y, _, carry = layer.apply_with_carry(params, {}, x[:, 3 * W:3 * W + 1],
+                                         carry)
+    np.testing.assert_allclose(np.asarray(y[:, 0]),
+                               np.asarray(full[:, 3 * W]),
+                               rtol=2e-4, atol=1e-5)
