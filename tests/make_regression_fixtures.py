@@ -96,6 +96,15 @@ def make_transformer():
                                seed=42)
 
 
+def make_transformer_v2():
+    """Modern-attention fixture: RoPE + GQA + sliding window must survive
+    the config round-trip forever once this zip is committed."""
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    return transformer_char_lm(vocab_size=7, d_model=8, n_heads=2, layers=1,
+                               seed=43, rope=True, n_kv_heads=1, window=4)
+
+
 def main():
     from deeplearning4j_tpu.models.serialization import write_model
 
@@ -111,6 +120,8 @@ def main():
                  np.eye(4, dtype=np.float32)[rs.randint(0, 4, (2, 6))]),
         "transformer": (make_transformer(), tid.astype(np.float32),
                         np.eye(7, dtype=np.float32)[np.roll(tid, -1, 1)]),
+        "transformer_v2": (make_transformer_v2(), tid.astype(np.float32),
+                           np.eye(7, dtype=np.float32)[np.roll(tid, -1, 1)]),
     }
     # INCREMENTAL: a case whose zip is already committed is an old-build
     # artifact — regenerating it would destroy exactly the backward-compat

@@ -16,7 +16,7 @@ from deeplearning4j_tpu.models.serialization import (
 )
 
 FIXTURES = Path(__file__).parent / "regression_fixtures"
-CASES = ["mlp", "cnn", "lstm", "transformer"]
+CASES = ["mlp", "cnn", "lstm", "transformer", "transformer_v2"]
 
 
 @pytest.mark.parametrize("name", CASES)
@@ -38,7 +38,7 @@ def test_restored_checkpoint_resumes_training(name):
         y = np.eye(3, dtype=np.float32)[np.zeros(len(x), int)]
     elif name == "cnn":
         y = np.eye(2, dtype=np.float32)[np.zeros(len(x), int)]
-    elif name == "transformer":
+    elif name.startswith("transformer"):
         y = np.eye(7, dtype=np.float32)[np.zeros((x.shape[0], x.shape[1]), int)]
     else:
         y = np.eye(4, dtype=np.float32)[np.zeros((x.shape[0], x.shape[1]), int)]
