@@ -456,7 +456,8 @@ class ComputationGraph(LazyScoreMixin):
                     self._loss_fn, has_aux=True
                 )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
                 grads = {k: v for k, v in grads.items() if v}
-                updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
+                updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                             lr_overrides, params=params)
                 new_params = dict(params)
                 for lname, u in updates.items():
                     new_params[lname] = upd.apply_updates(params[lname], u)

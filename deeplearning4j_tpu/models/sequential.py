@@ -192,7 +192,8 @@ class MultiLayerNetwork(LazyScoreMixin):
             )(params, net_state, x, y, rng, fmask, lmask, carries)
             grads = {k: v for k, v in grads.items() if v}
             updates, new_upd_state = upd.update(
-                updater_cfg, grads, upd_state, iteration, lr_overrides
+                updater_cfg, grads, upd_state, iteration, lr_overrides,
+                params=params,
             )
             new_params = dict(params)
             for lname, u in updates.items():

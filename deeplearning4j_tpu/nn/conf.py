@@ -31,7 +31,7 @@ class UpdaterConfig:
     """Updater + schedule hyperparameters (reference ``nn/conf/Updater.java``
     enum + lr/momentum schedule maps on the Builder)."""
 
-    name: str = "sgd"  # sgd|adam|adagrad|adadelta|nesterovs|rmsprop|none
+    name: str = "sgd"  # sgd|adam|adamw|adagrad|adadelta|nesterovs|rmsprop|none
     learning_rate: float = 0.1
     momentum: float = 0.9          # nesterovs
     rho: float = 0.95              # adadelta
@@ -40,10 +40,13 @@ class UpdaterConfig:
     adam_beta2: float = 0.999
     epsilon: float = 1e-8
     # learning-rate decay policy (reference LearningRatePolicy enum)
-    lr_policy: str = "none"        # none|exponential|inverse|step|poly|sigmoid|schedule
+    lr_policy: str = "none"        # none|exponential|inverse|step|poly|sigmoid|schedule|warmup_cosine
     lr_policy_decay_rate: float = 0.0
     lr_policy_steps: float = 1.0
     lr_policy_power: float = 1.0
+    lr_policy_warmup_steps: float = 0.0   # warmup_cosine: linear ramp length
+    lr_policy_min_fraction: float = 0.0   # warmup_cosine: floor fraction of base
+    weight_decay: float = 0.0      # adamw: DECOUPLED decay coefficient
     lr_schedule: Optional[Dict[int, float]] = None     # iteration -> lr
     momentum_schedule: Optional[Dict[int, float]] = None
     # gradient clipping/normalization (reference GradientNormalization enum)

@@ -45,6 +45,18 @@ def schedule_value(base: float, policy: str, cfg: UpdaterConfig, iteration,
         return base * jnp.power(1.0 - frac, cfg.lr_policy_power)
     if policy == "sigmoid":
         return base / (1.0 + jnp.exp(-cfg.lr_policy_decay_rate * (it - cfg.lr_policy_steps)))
+    if policy == "warmup_cosine":
+        # linear warmup to base over lr_warmup_steps, then cosine decay to
+        # base * lr_min_fraction at lr_policy_steps (total steps) — the
+        # standard transformer-training schedule (no reference analog:
+        # LearningRatePolicy predates it)
+        warm = jnp.maximum(cfg.lr_policy_warmup_steps, 1.0)
+        total = jnp.maximum(cfg.lr_policy_steps, warm + 1.0)
+        warm_frac = jnp.minimum(it / warm, 1.0)
+        prog = jnp.clip((it - warm) / (total - warm), 0.0, 1.0)
+        floor = cfg.lr_policy_min_fraction
+        cos = floor + (1.0 - floor) * 0.5 * (1.0 + jnp.cos(jnp.pi * prog))
+        return base * warm_frac * cos
     if policy == "schedule":
         # piecewise-constant: value switches at each breakpoint iteration
         if not schedule:
@@ -120,7 +132,7 @@ def init_state(cfg: UpdaterConfig, params):
         return {"ms": zeros()}
     if name == "adadelta":
         return {"msg": zeros(), "msdx": zeros()}
-    if name == "adam":
+    if name in ("adam", "adamw"):
         return {"m": zeros(), "v": zeros()}
     raise ValueError(f"Unknown updater '{cfg.name}'")
 
@@ -131,6 +143,7 @@ def update(
     state,
     iteration,
     lr_overrides: Optional[Dict[str, float]] = None,
+    params=None,
 ):
     """Compute updates (to SUBTRACT from params) and new updater state.
 
@@ -142,6 +155,10 @@ def update(
     """
     lr_overrides = lr_overrides or {}
     name = cfg.name
+    if name == "adamw" and params is None:
+        raise ValueError(
+            "adamw applies decoupled weight decay to the parameters; pass "
+            "params= to updaters.update() (all facade train steps do)")
     mu = current_momentum(cfg, iteration)
     it = jnp.asarray(iteration, jnp.float32)
 
@@ -167,6 +184,7 @@ def update(
     updates = {}
     for lname, lgrads in grads.items():
         lgrads = _flat(lgrads)
+        lparams_flat = _flat(params[lname]) if params is not None else {}
         lstate_flat = {k: _flat(state[k].get(lname, {})) for k in state}
         lgrads = normalize_gradients(cfg, lgrads)
         lr = current_lr(cfg, it, lr_overrides.get(lname))
@@ -200,13 +218,17 @@ def update(
                 u = dx  # adadelta has no lr
                 lns["msg"][pname] = msg
                 lns["msdx"][pname] = msdx
-            elif name == "adam":
+            elif name in ("adam", "adamw"):
                 m = cfg.adam_beta1 * lstate_flat["m"][pname] + (1 - cfg.adam_beta1) * g
                 v = cfg.adam_beta2 * lstate_flat["v"][pname] + (1 - cfg.adam_beta2) * g * g
                 t = it + 1.0
                 mhat = m / (1 - jnp.power(cfg.adam_beta1, t))
                 vhat = v / (1 - jnp.power(cfg.adam_beta2, t))
                 u = lr * mhat / (jnp.sqrt(vhat) + cfg.epsilon)
+                if name == "adamw" and cfg.weight_decay:
+                    # DECOUPLED decay (AdamW): acts on the param directly,
+                    # not through the adaptive denominator
+                    u = u + lr * cfg.weight_decay * lparams_flat[pname]
                 lns["m"][pname] = m
                 lns["v"][pname] = v
             else:
@@ -235,6 +257,9 @@ def as_optax(cfg: UpdaterConfig):
         return optax.sgd(lr, momentum=cfg.momentum, nesterov=True)
     if name == "adam":
         return optax.adam(lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2, eps=cfg.epsilon)
+    if name == "adamw":
+        return optax.adamw(lr, b1=cfg.adam_beta1, b2=cfg.adam_beta2,
+                           eps=cfg.epsilon, weight_decay=cfg.weight_decay)
     if name == "adagrad":
         return optax.adagrad(lr, eps=cfg.epsilon)
     if name == "adadelta":

@@ -160,7 +160,8 @@ class ParallelWrapper:
                 params, net_state, x, y, rng, fm, lm, None
             )
             grads = {k: v for k, v in grads.items() if v}
-            updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
+            updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                         lr_overrides, params=params)
             new_params = dict(params)
             for lname, u in updates.items():
                 new_params[lname] = upd.apply_updates(params[lname], u)

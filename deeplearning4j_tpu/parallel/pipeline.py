@@ -337,7 +337,8 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             grads = {**gp, **gb, **gs}
             grads = jax.tree_util.tree_map(jnp.add, grads,
                                            {k: reg_g[k] for k in grads})
-            updates, new_opt = upd.update(cfg, grads, opt_state, it, {})
+            updates, new_opt = upd.update(cfg, grads, opt_state, it, {},
+                                          params={k: tree[k] for k in grads})
             new_tree = {
                 k: (upd.apply_updates(v, updates[k]) if k in updates else v)
                 for k, v in tree.items()
@@ -469,7 +470,8 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             reg_vals.append(reg_val)  # no host sync inside the dispatch loop
             g = jax.tree_util.tree_map(jnp.add, grads[s], reg_grad)
             updates, stage_upd[s] = upd.update(
-                self._upd_cfg, g, stage_upd[s], it, self._lr_overrides)
+                self._upd_cfg, g, stage_upd[s], it, self._lr_overrides,
+                params=stage_params[s])
             stage_params[s] = {
                 ln: (upd.apply_updates(stage_params[s][ln], u)
                      if (u := updates.get(ln)) else stage_params[s][ln])

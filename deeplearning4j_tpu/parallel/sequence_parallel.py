@@ -241,7 +241,8 @@ class SequenceParallelTrainingMaster:
             grads = {k2: v for k2, v in grads.items() if v}
             grads = lax.pmean(lax.psum(grads, backend.AXIS_SEQ), backend.AXIS_DATA)
             new_ns = lax.pmean(new_ns, axes) if new_ns else new_ns
-            updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
+            updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                         lr_overrides, params=params)
             new_params = {
                 ln: (upd.apply_updates(params[ln], u)
                      if (u := updates.get(ln)) else params[ln])

@@ -179,7 +179,8 @@ class SyncTrainingMaster(TrainingMaster):
                 params, net_state, x, y, rng, fm, lm, None
             )
             grads = {k: v for k, v in grads.items() if v}
-            updates, new_us = upd.update(cfg, grads, upd_state, iteration, lr_overrides)
+            updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                         lr_overrides, params=params)
             new_params = {
                 ln: (upd.apply_updates(params[ln], u)
                      if (u := updates.get(ln)) else params[ln])

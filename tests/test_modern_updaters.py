@@ -1,0 +1,94 @@
+"""AdamW (decoupled weight decay) + warmup-cosine schedule.
+
+No reference analog (``nn/conf/Updater.java`` predates both); these are the
+standard transformer-training pieces, built into the same updater/schedule
+machinery as the reference-era policies.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, UpdaterConfig
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optimize import updaters as upd
+
+
+def test_warmup_cosine_schedule_shape():
+    cfg = UpdaterConfig(name="adam", learning_rate=1.0,
+                        lr_policy="warmup_cosine", lr_policy_warmup_steps=10,
+                        lr_policy_steps=110, lr_policy_min_fraction=0.1)
+    lrs = [float(upd.current_lr(cfg, i)) for i in range(0, 121, 5)]
+    # ramps linearly to base at warmup end
+    assert abs(float(upd.current_lr(cfg, 5)) - 0.5) < 1e-6
+    assert abs(float(upd.current_lr(cfg, 10)) - 1.0) < 1e-6
+    # monotone decay after warmup, down to the floor
+    after = lrs[2:]
+    assert all(a >= b - 1e-9 for a, b in zip(after, after[1:]))
+    assert abs(float(upd.current_lr(cfg, 110)) - 0.1) < 1e-6
+    assert abs(float(upd.current_lr(cfg, 500)) - 0.1) < 1e-6  # clamped floor
+    # midpoint of the cosine ~ halfway between base and floor
+    mid = float(upd.current_lr(cfg, 60))
+    assert abs(mid - 0.55) < 1e-6
+
+
+def test_adamw_decoupled_decay_math():
+    """One adamw step == one adam step + lr*wd*param pulled directly from
+    the parameter (not through the adaptive denominator)."""
+    params = {"l": {"W": jnp.asarray(np.ones((3, 3), np.float32) * 2.0)}}
+    grads = {"l": {"W": jnp.asarray(np.full((3, 3), 0.5, np.float32))}}
+    adam = UpdaterConfig(name="adam", learning_rate=0.1)
+    adamw = UpdaterConfig(name="adamw", learning_rate=0.1, weight_decay=0.01)
+    s1 = upd.init_state(adam, params)
+    s2 = upd.init_state(adamw, params)
+    u1, _ = upd.update(adam, grads, s1, 0, params=params)
+    u2, _ = upd.update(adamw, grads, s2, 0, params=params)
+    diff = np.asarray(u2["l"]["W"] - u1["l"]["W"])
+    np.testing.assert_allclose(diff, 0.1 * 0.01 * 2.0, rtol=1e-5)
+
+
+def test_adamw_requires_params():
+    cfg = UpdaterConfig(name="adamw", weight_decay=0.01)
+    with pytest.raises(ValueError, match="adamw"):
+        upd.update(cfg, {"l": {"W": jnp.ones((2, 2))}},
+                   upd.init_state(cfg, {"l": {"W": jnp.ones((2, 2))}}), 0)
+
+
+def test_adamw_warmup_cosine_trains_via_facade():
+    """Builder plumbing end-to-end: .updater('adamw', ...) with the
+    warmup_cosine policy trains, decays weights, and round-trips config."""
+    conf = (NeuralNetConfiguration.builder().seed(3)
+            .updater("adamw", learning_rate=0.01, weight_decay=0.1)
+            .lr_policy("warmup_cosine", warmup_steps=5, steps=50,
+                       min_fraction=0.1)
+            .list()
+            .layer(DenseLayer(n_in=6, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 6).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rs.randint(0, 2, 16)]
+    import dataclasses
+
+    # same run without decay: the decayed weights must end up measurably
+    # smaller, proving weight_decay survives the builder->fit plumbing
+    conf_nodecay = dataclasses.replace(
+        conf, updater=dataclasses.replace(conf.updater, weight_decay=0.0))
+    net_nd = MultiLayerNetwork(conf_nodecay).init()
+    for _ in range(20):
+        net.fit(x, y)
+        net_nd.fit(x, y)
+    assert np.isfinite(net.score_value)
+    w_decay = float(jnp.abs(net.params["layer_0"]["W"]).mean())
+    w_plain = float(jnp.abs(net_nd.params["layer_0"]["W"]).mean())
+    assert w_decay < w_plain, (w_decay, w_plain)
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    back = MultiLayerConfiguration.from_json(conf.to_json())
+    assert back.updater.name == "adamw"
+    assert back.updater.weight_decay == 0.1
+    assert back.updater.lr_policy == "warmup_cosine"
