@@ -17,8 +17,39 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _filter_logits(logits: jax.Array, top_k: Optional[int],
+                   top_p: Optional[float]) -> jax.Array:
+    """Standard nucleus/top-k logit filtering: everything outside the kept
+    set drops to -inf before the categorical draw."""
+    neg = jnp.asarray(-1e30, logits.dtype)
+    if top_k is not None:
+        if top_k < 1:
+            raise ValueError(f"top_k={top_k} must be >= 1")
+        k = min(top_k, logits.shape[-1])   # clamp to vocab
+        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+        logits = jnp.where(logits >= kth, logits, neg)
+    if top_p is not None:
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p={top_p} must be in (0, 1]; for greedy "
+                             "use temperature=0")
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative mass >= top_p (always
+        # keep the argmax)
+        keep_sorted = cum - probs < top_p
+        # threshold = the SMALLEST kept logit
+        cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits,
+                                   jnp.asarray(jnp.inf, logits.dtype)),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits >= cutoff, logits, neg)
+    return logits
+
+
 def sample_sequence(net, prompt_ids, steps: int, *,
                     temperature: float = 1.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
                     rng: Optional[jax.Array] = None,
                     one_hot: Optional[bool] = None,
                     vocab_size: Optional[int] = None) -> np.ndarray:
@@ -28,8 +59,9 @@ def sample_sequence(net, prompt_ids, steps: int, *,
     input encoding per step: True feeds one-hot vectors (LSTM char-LM
     configs whose first layer consumes features), False feeds raw ids
     (embedding-first transformers).  Auto-detected from the first layer
-    when None.  ``temperature`` <= 0 means greedy argmax.  Returns the
-    sampled ids [B, steps].
+    when None.  ``temperature`` <= 0 means greedy argmax; ``top_k`` /
+    ``top_p`` (nucleus) filter the distribution before sampling.
+    Returns the sampled ids [B, steps].
     """
     from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
 
@@ -70,6 +102,7 @@ def sample_sequence(net, prompt_ids, steps: int, *,
         if temperature and temperature > 0:
             rng, key = jax.random.split(rng)
             logits = jnp.log(jnp.maximum(probs, 1e-30)) / temperature
+            logits = _filter_logits(logits, top_k, top_p)
             tok = jax.random.categorical(key, logits, axis=-1)
         else:
             tok = jnp.argmax(probs, axis=-1)

@@ -623,3 +623,47 @@ def test_rolling_window_cache_unbounded_decode():
     np.testing.assert_allclose(np.asarray(y[:, 0]),
                                np.asarray(full[:, 3 * W]),
                                rtol=2e-4, atol=1e-5)
+
+
+def test_sampling_topk_topp_filters():
+    """top-k keeps exactly k candidates; nucleus keeps the smallest prefix
+    covering top_p mass (always >= 1 token); filtered sampling only ever
+    draws kept ids."""
+    from deeplearning4j_tpu.utils.sampling import _filter_logits
+
+    logits = jnp.asarray(np.log(np.array([[0.5, 0.3, 0.15, 0.05]],
+                                         np.float32)))
+    k2 = np.asarray(_filter_logits(logits, 2, None))
+    assert (k2[0, :2] > -1e29).all() and (k2[0, 2:] < -1e29).all()
+    p6 = np.asarray(_filter_logits(logits, None, 0.6))
+    # 0.5 alone < 0.6 -> keep {0.5, 0.3}
+    assert (p6[0, :2] > -1e29).all() and (p6[0, 2:] < -1e29).all()
+    p01 = np.asarray(_filter_logits(logits, None, 0.01))
+    assert (p01[0, :1] > -1e29).all() and (p01[0, 1:] < -1e29).all()
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+    from deeplearning4j_tpu.utils.sampling import sample_sequence
+
+    net = transformer_char_lm(vocab_size=9, d_model=8, n_heads=2, layers=1)
+    out = sample_sequence(net, np.array([[1, 2]]), steps=6, temperature=1.0,
+                          top_k=3, top_p=0.9, rng=jax.random.PRNGKey(2))
+    assert out.shape == (1, 6) and out.min() >= 0 and out.max() < 9
+
+
+def test_sampling_filter_edge_cases():
+    from deeplearning4j_tpu.utils.sampling import _filter_logits
+
+    logits = jnp.asarray(np.log(np.array([[0.5, 0.3, 0.15, 0.05]],
+                                         np.float32)))
+    # top_k beyond vocab clamps (keeps everything)
+    allk = np.asarray(_filter_logits(logits, 100, None))
+    assert (allk > -1e29).all()
+    with pytest.raises(ValueError, match="top_k"):
+        _filter_logits(logits, 0, None)
+    with pytest.raises(ValueError, match="top_p"):
+        _filter_logits(logits, None, 0.0)
+    with pytest.raises(ValueError, match="top_p"):
+        _filter_logits(logits, None, 1.5)
+    # top_p = 1.0 keeps everything
+    p1 = np.asarray(_filter_logits(logits, None, 1.0))
+    assert (p1 > -1e29).all()
