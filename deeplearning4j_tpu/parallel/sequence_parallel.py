@@ -141,7 +141,8 @@ def ring_attention(q, k, v, mask=None, *, axis_name: str,
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)   # [B,T,H,D]
 
 
-def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                      window: Optional[int] = None):
     """DeepSpeed-Ulysses-style all-to-all sequence parallelism.
 
     Inside ``shard_map``: reshard time-sharded heads to head-sharded full
@@ -149,8 +150,11 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
     ``H % n_shards == 0``.
     """
     from deeplearning4j_tpu.helpers import get_helper
-    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+    from deeplearning4j_tpu.nn.layers.attention import (
+        check_window, dot_product_attention,
+    )
 
+    check_window(causal, window)
     n_shards = lax.psum(1, axis_name)
 
     def to_heads(x):   # [B, T/P, H, D] -> [B, T, H/P, D]
@@ -164,14 +168,15 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
     if (helper is not None and qh.dtype != jnp.float64
             and helper.supports(qh.shape[1], qh.shape[3],
                                 under_shard_map=True)):
-        o = helper.attend(qh, kh, vh, causal=causal)
+        o = helper.attend(qh, kh, vh, causal=causal, window=window)
     else:
-        o = dot_product_attention(qh, kh, vh, causal=causal)
+        o = dot_product_attention(qh, kh, vh, causal=causal, window=window)
     return lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
 def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None, *,
-                        causal: bool = False, impl: str = "ring",
+                        causal: bool = False, window: Optional[int] = None,
+                        impl: str = "ring",
                         seq_axis: str = backend.AXIS_SEQ):
     """Convenience wrapper: global [B, T, H, D] arrays in, attention over a
     sequence-sharded mesh, global-layout result out (still sharded)."""
@@ -179,7 +184,8 @@ def ring_self_attention(q, k, v, mesh: Optional[Mesh] = None, *,
     fn = ring_attention if impl == "ring" else ulysses_attention
     spec = P(None, seq_axis)
     return shard_map(
-        functools.partial(fn, axis_name=seq_axis, causal=causal),
+        functools.partial(fn, axis_name=seq_axis, causal=causal,
+                          window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )(q, k, v)
 

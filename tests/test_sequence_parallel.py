@@ -178,3 +178,16 @@ def test_sequence_parallel_training_matches_single_device():
     sp_vec = sp_net.params_to_vector()
     np.testing.assert_allclose(sp_vec, ref_vec, rtol=1e-4, atol=1e-5)
     assert abs(sp_net.score_value - ref.score_value) < 1e-4
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_windowed_distributed_attention_matches_exact(impl):
+    """Both SP implementations accept window and match exact banded
+    attention (global positions line up across shards / reshards)."""
+    rng = np.random.default_rng(5)
+    q, k, v = _qkv(rng, b=1, t=16, h=4, d=4)
+    mesh = _seq_mesh(4)
+    got = ring_self_attention(q, k, v, mesh, causal=True, window=6, impl=impl)
+    want = dot_product_attention(q, k, v, causal=True, window=6)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
