@@ -25,45 +25,66 @@ from deeplearning4j_tpu.parallel.training_master import SyncTrainingMaster
 
 
 def tensor_parallel_spec(params: Dict[str, Dict[str, Any]], tp: int,
-                         axis: str = backend.AXIS_MODEL) -> Dict[str, Dict[str, P]]:
-    """Per-parameter PartitionSpecs.
+                         axis: str = backend.AXIS_MODEL) -> Dict[str, Dict[str, Any]]:
+    """Per-parameter PartitionSpecs, recursive over nested param trees
+    (composite layers like ResidualBlock nest sublayer dicts).
 
-    Rules (layer order = alternation order):
-      - 2-D weights: alternate column-parallel P(None, axis) / row-parallel
-        P(axis, None) down the layer stack — back-to-back dense layers then
-        need a single collective pair per block (Megatron MLP pattern);
+    Rules:
+      - attention groups ({Wq, Wk, Wv, Wo} siblings): Megatron attention —
+        Wq/Wk/Wv column-parallel (shards heads), Wo row-parallel, so the
+        whole attention block needs one collective pair;
+      - other 2-D weights: alternate column-parallel P(None, axis) /
+        row-parallel P(axis, None) in traversal order — back-to-back dense
+        layers then need a single collective pair per block (Megatron MLP);
       - 4-D conv kernels [kh,kw,cin,cout]: shard cout;
       - 3-D expert tensors [E,...]: shard the expert axis (EP);
       - biases/vectors and anything not divisible by tp: replicated.
     """
-    specs: Dict[str, Dict[str, P]] = {}
-    parity = 0
-    for lname, lparams in params.items():
-        lspec: Dict[str, P] = {}
-        saw_matrix = False
-        for pname, arr in lparams.items():
-            nd = getattr(arr, "ndim", 0)
-            shape = getattr(arr, "shape", ())
-            if nd == 2 and pname.startswith("W"):
-                if parity % 2 == 0 and shape[1] % tp == 0:
-                    lspec[pname] = P(None, axis)
-                elif parity % 2 == 1 and shape[0] % tp == 0:
-                    lspec[pname] = P(axis, None)
-                else:
-                    lspec[pname] = P()
-                saw_matrix = True
-            elif nd == 4 and shape[-1] % tp == 0:
-                lspec[pname] = P(None, None, None, axis)   # conv cout
-                saw_matrix = True
-            elif nd == 3 and shape[0] % tp == 0:
-                lspec[pname] = P(axis, None, None)         # MoE experts
-                saw_matrix = True
+    parity = [0]
+
+    def leaf_spec(pname, arr, attn, par):
+        nd = getattr(arr, "ndim", 0)
+        shape = getattr(arr, "shape", ())
+        if nd == 2 and pname.startswith("W"):
+            if attn:
+                if pname in ("Wq", "Wk", "Wv") and shape[1] % tp == 0:
+                    return P(None, axis), True
+                if pname == "Wo" and shape[0] % tp == 0:
+                    return P(axis, None), True
+                return P(), True
+            if par % 2 == 0 and shape[1] % tp == 0:
+                return P(None, axis), True
+            if par % 2 == 1 and shape[0] % tp == 0:
+                return P(axis, None), True
+            return P(), True
+        if nd == 4 and shape and shape[-1] % tp == 0:
+            return P(None, None, None, axis), True         # conv cout
+        if nd == 3 and shape and shape[0] % tp == 0:
+            return P(axis, None, None), True               # MoE experts
+        return P(), False
+
+    def walk(tree):
+        out = {}
+        keys = set(tree.keys())
+        attn = {"Wq", "Wk", "Wv", "Wo"} <= keys
+        saw = False
+        for pname, v in tree.items():
+            if isinstance(v, dict):
+                out[pname] = walk(v)
             else:
-                lspec[pname] = P()
-        specs[lname] = lspec
-        if saw_matrix:
-            parity += 1
-    return specs
+                spec, matrix = leaf_spec(pname, v, attn, parity[0])
+                out[pname] = spec
+                saw = saw or (matrix and not attn)
+        if attn:
+            # the attention group is a complete col->row stage; snap parity
+            # to the next EVEN value so the following FFN starts
+            # column-parallel (one collective pair per block)
+            parity[0] = (parity[0] // 2 + 1) * 2
+        elif saw:
+            parity[0] += 1
+        return out
+
+    return {lname: walk(lparams) for lname, lparams in params.items()}
 
 
 class TensorParallelTrainingMaster(SyncTrainingMaster):
@@ -84,7 +105,12 @@ class TensorParallelTrainingMaster(SyncTrainingMaster):
 
     def _param_layout(self, net):
         specs = tensor_parallel_spec(net.params, self.tp)
-        return {
-            ln: {pn: NamedSharding(self.mesh, s) for pn, s in lp.items()}
-            for ln, lp in specs.items()
-        }
+
+        def to_shardings(tree):
+            return {
+                k: (to_shardings(v) if isinstance(v, dict)
+                    else NamedSharding(self.mesh, v))
+                for k, v in tree.items()
+            }
+
+        return to_shardings(specs)
