@@ -127,6 +127,24 @@ def _is_oom(e: Exception) -> bool:
     return any(m in str(e) for m in _OOM_MARKERS)
 
 
+def _matmul_params(net) -> int:
+    """Parameter count restricted to matmul-bearing weights: rank >= 2
+    arrays, with embedding tables excluded (their lookup is a gather, not a
+    matmul) — the count the 6·N·tokens analytic FLOP estimate is valid for."""
+    import jax
+
+    from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
+
+    total = 0
+    for layer in net.layers:
+        if isinstance(layer, EmbeddingLayer):
+            continue
+        for p in jax.tree_util.tree_leaves(net.params.get(layer.name, {})):
+            if p.ndim >= 2:
+                total += int(np.prod(p.shape))
+    return total
+
+
 def _sync(out):
     """Force completion by fetching the value to host.  On the tunneled TPU
     platform ``jax.block_until_ready`` can return before remote execution
@@ -137,19 +155,24 @@ def _sync(out):
     return np.asarray(jax.device_get(out))
 
 
-def _time_loop(run_one, warmup, iters, block):
+def _time_loop(run_one, warmup, iters, block, reps=1):
     """Steady-state per-step time: chain ``iters`` steps (each consuming the
     previous step's outputs) and block once at the end — async dispatch hides
-    host/tunnel latency exactly as a real training loop does."""
+    host/tunnel latency exactly as a real training loop does.  With
+    ``reps > 1`` the timed loop repeats (variance measurement); always
+    returns the list of per-rep mean step times."""
     out = None
     for _ in range(warmup):
         out = run_one()
     block(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run_one()
-    block(out)
-    return (time.perf_counter() - t0) / iters
+    ts = []
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = run_one()
+        block(out)
+        ts.append((time.perf_counter() - t0) / iters)
+    return ts
 
 
 def _time_loop_synced(run_one, iters, block):
@@ -163,15 +186,40 @@ def _time_loop_synced(run_one, iters, block):
     return float(np.median(ts))
 
 
-def _checked_time(run_one, warmup, iters, block, flops, peak):
-    """Chained timing, re-measured hard-synced if the implied FLOP/s exceeds
-    the chip's peak (a physically impossible reading — seen when the device
-    tunnel misreports readiness)."""
-    dt = _time_loop(run_one, warmup, iters, block)
+# run-to-run spread gate: tunnel jitter showed an 18% ResNet spread in
+# round 3 (PROFILE.md 56 vs 66 ms); anything past this is flagged loudly
+SPREAD_THRESHOLD = 0.15
+
+
+def _checked_time(run_one, warmup, iters, block, flops, peak, reps=3):
+    """Variance-aware chained timing: ``reps`` repeats of the timed loop,
+    median + IQR reported, re-measured hard-synced if the implied FLOP/s
+    exceeds the chip's peak (a physically impossible reading — seen when
+    the device tunnel misreports readiness).
+
+    Returns (dt_median_seconds, timing_mode, spread_dict); spread carries
+    per-rep medians so a future regression inside the jitter band is
+    visible, and ``noisy: true`` + a stderr warning when IQR/median exceeds
+    SPREAD_THRESHOLD (the JSON artifact still prints — a noisy number with
+    its spread beats no number)."""
+    ts = _time_loop(run_one, warmup, iters, block, reps=reps)
+    dt = float(np.median(ts))
+    q1, q3 = (np.percentile(ts, [25, 75]) if len(ts) > 1 else (dt, dt))
+    iqr = float(q3 - q1)
+    rel = iqr / dt if dt > 0 else 0.0
+    noisy = rel > SPREAD_THRESHOLD
+    if noisy:
+        print(f"bench WARNING: run-to-run spread {rel:.1%} exceeds "
+              f"{SPREAD_THRESHOLD:.0%} (per-rep ms: "
+              f"{[round(t * 1e3, 3) for t in ts]})", file=sys.stderr)
+    spread = {"reps": len(ts), "iqr_ms": round(iqr * 1e3, 3),
+              "rel_iqr": round(rel, 4), "noisy": noisy,
+              "rep_ms": [round(t * 1e3, 3) for t in ts]}
+    mode = "chained"
     if flops and peak and flops / dt > peak:
         dt = max(dt, _time_loop_synced(run_one, max(5, iters // 4), block))
-        return dt, "synced"
-    return dt, "chained"
+        mode = "synced"
+    return dt, mode, spread
 
 
 def bench_lenet(platform, baselines):
@@ -201,7 +249,7 @@ def bench_lenet(platform, baselines):
 
     warmup, iters = (5, 100) if platform == "tpu" else (2, 10)
     peak = _peak_flops(jax.devices()[0])
-    dt, timing = _checked_time(one, warmup, iters, _sync, flops, peak)
+    dt, timing, spread = _checked_time(one, warmup, iters, _sync, flops, peak)
     base = baselines["lenet_step_ms"]
     return {
         "metric": "LeNet-MNIST train step time (batch 128)",
@@ -213,6 +261,7 @@ def bench_lenet(platform, baselines):
         "flops_per_step": flops,
         "imgs_per_sec": round(batch / dt, 1),
         "timing": timing,
+        "spread": spread,
     }
 
 
@@ -244,8 +293,8 @@ def bench_resnet50(platform, baselines, peak):
                 return loss
 
             warmup, iters = (3, 50) if platform == "tpu" else (1, 2)
-            dt, timing = _checked_time(one, warmup, iters, _sync,
-                                       flops, peak)
+            dt, timing, spread = _checked_time(one, warmup, iters, _sync,
+                                               flops, peak)
             imgs = batch / dt
             base = baselines["resnet50_imgs_per_sec"]
             mfu = (flops / dt / peak) if (flops and peak) else None
@@ -261,6 +310,7 @@ def bench_resnet50(platform, baselines, peak):
                 "step_ms": round(dt * 1e3, 2),
                 "mfu": round(mfu, 4) if mfu is not None else None,
                 "timing": timing,
+                "spread": spread,
             }
         except Exception as e:
             if not _is_oom(e):
@@ -294,7 +344,7 @@ def bench_graves_lstm(platform, baselines, peak):
         return loss
 
     warmup, iters = (3, 50) if platform == "tpu" else (1, 3)
-    dt, timing = _checked_time(one, warmup, iters, _sync, flops, peak)
+    dt, timing, spread = _checked_time(one, warmup, iters, _sync, flops, peak)
     chars = batch * seq / dt
     base = baselines["lstm_chars_per_sec"]
     mfu = (flops / dt / peak) if (flops and peak) else None
@@ -311,6 +361,7 @@ def bench_graves_lstm(platform, baselines, peak):
         "step_ms": round(dt * 1e3, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "timing": timing,
+        "spread": spread,
     }
 
 
@@ -324,11 +375,31 @@ def bench_transformer(platform, baselines, peak):
     from deeplearning4j_tpu.models.zoo import transformer_char_lm
 
     if platform == "tpu":
-        # GPT-2-medium-class: measured 59.6% MFU on the v5e (PROFILE.md);
-        # width is what fills the MXU (d512 -> 28%, d2048 -> 68%)
-        batch, seq, d_model, heads, layers = 8, 2048, 1024, 8, 8
+        # width is what fills the MXU (measured sweep: d512 28%, d1024 60%,
+        # d2048 68% — PROFILE.md); flagship is the widest config that fits,
+        # with the d1024 GPT-2-medium-class config as OOM fallback
+        configs = [(8, 2048, 2048, 8, 8), (8, 2048, 1024, 8, 8)]
     else:
-        batch, seq, d_model, heads, layers = 2, 256, 64, 2, 1
+        configs = [(2, 256, 64, 2, 1)]
+    last_err = None
+    for batch, seq, d_model, heads, layers in configs:
+        try:
+            return _bench_transformer_config(
+                platform, peak, batch, seq, d_model, heads, layers)
+        except Exception as e:
+            if not _is_oom(e):
+                raise
+            last_err = e
+    raise RuntimeError(f"transformer bench OOM at all configs: {last_err}")
+
+
+def _bench_transformer_config(platform, peak, batch, seq, d_model, heads,
+                              layers):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
     vocab = 128
     net = transformer_char_lm(vocab_size=vocab, d_model=d_model,
                               n_heads=heads, layers=layers,
@@ -339,18 +410,22 @@ def bench_transformer(platform, baselines, peak):
     y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
     step = net._get_train_step()
     state = [net.params, net.updater_state, net.net_state]
-    flops, compiled = _compile_step(step, state[0], state[1], state[2],
-                                    jnp.zeros(()), x, y, net._keys.next(),
-                                    None, None, None)
+    xla_flops, compiled = _compile_step(step, state[0], state[1], state[2],
+                                        jnp.zeros(()), x, y, net._keys.next(),
+                                        None, None, None)
     # XLA cost analysis reports the Pallas flash-attention custom call as
     # zero FLOPs; use the standard analytic transformer count instead
     # (6·N·tokens for the dense matmuls fwd+bwd, 12·L·H·T²·Dh for
     # attention, halved for causal masking) and keep whichever is larger.
-    n_params = net.num_params()
-    analytic = (6.0 * n_params * batch * seq
+    # N counts only matmul-bearing params (weights of rank >= 2, embedding
+    # table excluded — its lookup is a gather): counting biases/LayerNorm/
+    # embeddings as matmul FLOPs would overstate MFU.  Both estimates are
+    # reported; flops_per_step is their max.
+    n_matmul = _matmul_params(net)
+    analytic = (6.0 * n_matmul * batch * seq
                 + 12.0 * layers * heads * seq * seq * (d_model // heads)
                 * batch * 0.5)
-    flops_src = "xla_cost_analysis"
+    flops, flops_src = xla_flops, "xla_cost_analysis"
     if analytic > flops:
         flops, flops_src = analytic, "analytic"
 
@@ -361,7 +436,7 @@ def bench_transformer(platform, baselines, peak):
         return loss
 
     warmup, iters = (3, 30) if platform == "tpu" else (1, 3)
-    dt, timing = _checked_time(one, warmup, iters, _sync, flops, peak)
+    dt, timing, spread = _checked_time(one, warmup, iters, _sync, flops, peak)
     toks = batch * seq / dt
     mfu = (flops / dt / peak) if (flops and peak) else None
     return {
@@ -376,9 +451,177 @@ def bench_transformer(platform, baselines, peak):
         "seq_len": seq,
         "flops_per_step": flops,
         "flops_source": flops_src,
+        "flops_xla": xla_flops,
+        "flops_analytic": analytic,
         "step_ms": round(dt * 1e3, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "timing": timing,
+        "spread": spread,
+    }
+
+
+def bench_decode(platform, peak):
+    """Autoregressive decode throughput through the KV-cache streaming path
+    (≙ reference streaming inference ``MultiLayerNetwork.rnnTimeStep``
+    :2195-2224, compiled here into one scanned XLA program —
+    ``models/decode.py``).  Decode is HBM-bandwidth-bound on the cache, so
+    the variants measure exactly what GQA and the rolling-window cache were
+    built to shrink: MHA vs GQA (4x fewer KV heads) vs GQA+rolling window
+    (fixed O(window) cache)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.common import (
+        check_cache_capacity, seed_stream_caches,
+    )
+    from deeplearning4j_tpu.models.decode import build_decode_fn
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    if platform == "tpu":
+        batch, d_model, heads, layers = 16, 1024, 8, 8
+        steps, cache = 256, 2048
+        warmup, iters = (2, 8)
+    else:
+        batch, d_model, heads, layers = 2, 32, 2, 1
+        steps, cache = 8, 32
+        warmup, iters = (1, 2)
+    vocab = 128
+    window = cache // 8
+    variants = [
+        ("mha", dict()),
+        ("gqa2", dict(n_kv_heads=2)),
+        ("gqa2_rolling", dict(n_kv_heads=2, window=window)),
+    ]
+    results = {}
+    for name, kw in variants:
+        net = transformer_char_lm(
+            vocab_size=vocab, d_model=d_model, n_heads=heads, layers=layers,
+            max_cache=cache,
+            compute_dtype="bfloat16" if platform == "tpu" else None, **kw)
+        carries = seed_stream_caches(
+            ((l.name, l) for l in net.layers), {}, batch,
+            net.conf.compute_dtype)
+        check_cache_capacity(carries, 1 + steps, pos=0)
+        fn = jax.jit(build_decode_fn(net, steps, temperature=1.0))
+        prompt = jnp.zeros((batch, 1), jnp.int32)
+        key = jax.random.PRNGKey(0)
+
+        def one():
+            ids, _ = fn(net.params, net.net_state, carries, prompt, key)
+            return ids
+
+        dt, timing, spread = _checked_time(one, warmup, iters, _sync, 0, 0)
+        per_tok = dt / steps
+        # HBM the cache streams per decoded token (each layer reads its
+        # full K+V cache every step) — the bandwidth story the variants
+        # differ by; bf16 on TPU
+        bytes_el = 2 if platform == "tpu" else 4
+        kv_len = min(cache, window) if kw.get("window") else cache
+        kv_heads = kw.get("n_kv_heads", heads)
+        cache_bytes = (2 * layers * kv_len * kv_heads * (d_model // heads)
+                       * bytes_el * batch)
+        results[name] = {
+            "tokens_per_sec": round(batch / per_tok, 1),
+            "per_token_ms": round(per_tok * 1e3, 4),
+            "kv_cache_mb": round(cache_bytes / 1e6, 1),
+            "implied_cache_gbps": round(cache_bytes / per_tok / 1e9, 1),
+            "timing": timing,
+            "spread": spread,
+        }
+    mha = results["mha"]
+    # top-level spread: the NOISIEST variant (per-variant spreads are under
+    # `variants`; mirroring only MHA here would hide a jittery variant)
+    worst_name = max(results, key=lambda n: results[n]["spread"]["rel_iqr"])
+    worst = dict(results[worst_name]["spread"], variant=worst_name)
+    return {
+        "metric": (f"Decode tokens/sec (d{d_model} L{layers}, b{batch}, "
+                   f"{steps}-token scan, KV cache {cache})"),
+        "value": mha["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,   # no reference analog measured (streaming
+        # inference exists in the reference but was never benchmarked)
+        "data": "synthetic",
+        "dtype": "bfloat16" if platform == "tpu" else "float32",
+        "batch": batch,
+        "decode_steps": steps,
+        "variants": results,
+        "gqa_speedup": round(results["gqa2"]["tokens_per_sec"]
+                             / mha["tokens_per_sec"], 2),
+        "rolling_speedup": round(results["gqa2_rolling"]["tokens_per_sec"]
+                                 / mha["tokens_per_sec"], 2),
+        "spread": worst,
+    }
+
+
+def bench_long_context(platform, peak):
+    """Long-context training row: T=8192 on one chip via sliding-window
+    flash attention (out-of-band blocks' compute AND HBM fetches skipped)
+    + remat blocks (jax.checkpoint) for the activation budget.  The
+    composition docs/LONG_CONTEXT.md claims, timed."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    if platform == "tpu":
+        batch, seq, d_model, heads, layers, window = 2, 8192, 1024, 8, 8, 1024
+    else:
+        batch, seq, d_model, heads, layers, window = 1, 512, 32, 2, 1, 128
+    vocab = 128
+    net = transformer_char_lm(
+        vocab_size=vocab, d_model=d_model, n_heads=heads, layers=layers,
+        window=window, remat=True,
+        compute_dtype="bfloat16" if platform == "tpu" else None)
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
+    step = net._get_train_step()
+    state = [net.params, net.updater_state, net.net_state]
+    xla_flops, compiled = _compile_step(step, state[0], state[1], state[2],
+                                        jnp.zeros(()), x, y, net._keys.next(),
+                                        None, None, None)
+    # analytic: dense matmuls 6·N·tokens + windowed attention — each query
+    # sees ~window keys (12·L·H·T·W·Dh fwd+bwd, no causal halving inside
+    # the band).  Remat recompute is NOT counted (standard MFU convention:
+    # useful FLOPs only).
+    n_matmul = _matmul_params(net)
+    analytic = (6.0 * n_matmul * batch * seq
+                + 12.0 * layers * heads * seq * min(window, seq)
+                * (d_model // heads) * batch)
+    flops, flops_src = xla_flops, "xla_cost_analysis"
+    if analytic > flops:
+        flops, flops_src = analytic, "analytic"
+
+    def one():
+        state[0], state[1], state[2], loss, _ = compiled(
+            state[0], state[1], state[2], jnp.zeros(()), x, y,
+            net._keys.next(), None, None, None)
+        return loss
+
+    warmup, iters = (2, 20) if platform == "tpu" else (1, 2)
+    dt, timing, spread = _checked_time(one, warmup, iters, _sync, flops, peak)
+    toks = batch * seq / dt
+    mfu = (flops / dt / peak) if (flops and peak) else None
+    return {
+        "metric": (f"Long-context train tokens/sec (d{d_model} L{layers} "
+                   f"T{seq}, window {window}, remat)"),
+        "value": round(toks, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": None,  # no reference analog (pre-transformer)
+        "data": "synthetic",
+        "dtype": "bfloat16" if platform == "tpu" else "float32",
+        "batch": batch,
+        "seq_len": seq,
+        "window": window,
+        "flops_per_step": flops,
+        "flops_source": flops_src,
+        "flops_xla": xla_flops,
+        "flops_analytic": analytic,
+        "step_ms": round(dt * 1e3, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "timing": timing,
+        "spread": spread,
     }
 
 
@@ -394,7 +637,9 @@ def main():
     for fn in (lambda: bench_resnet50(platform, baselines, peak),
                lambda: bench_lenet(platform, baselines),
                lambda: bench_graves_lstm(platform, baselines, peak),
-               lambda: bench_transformer(platform, baselines, peak)):
+               lambda: bench_transformer(platform, baselines, peak),
+               lambda: bench_decode(platform, peak),
+               lambda: bench_long_context(platform, peak)):
         try:
             metrics.append(fn())
         except Exception as e:

@@ -53,20 +53,25 @@ def seed_stream_caches(named_layers, rnn_state, batch, compute_dtype):
     return carries
 
 
-def check_cache_capacity(carries, t_new: int) -> None:
+def check_cache_capacity(carries, t_new: int, pos: int | None = None) -> None:
     """Raise before dispatch when a streamed chunk would overflow any
     attention KV cache — ``dynamic_update_slice`` clamps out-of-range
-    writes and would silently relocate keys instead of failing."""
+    writes and would silently relocate keys instead of failing.
+
+    ``pos`` is the facade's host-side stream-position counter; passing it
+    keeps this check free of device->host syncs in the decode hot loop
+    (all caches advance in lockstep with the streamed input)."""
     from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
 
     def walk(name, c):
         if not isinstance(c, dict):
             return
         if "pos" in c and "k" in c:
-            if SelfAttentionLayer.cache_overflow(c, t_new):
+            if SelfAttentionLayer.cache_overflow(c, t_new, pos=pos):
+                at = pos if pos is not None else int(c["pos"])
                 raise ValueError(
                     f"rnn_time_step: streaming past the KV cache of "
-                    f"'{name}' (pos={int(c['pos'])} + {t_new} > "
+                    f"'{name}' (pos={at} + {t_new} > "
                     f"max_cache={c['k'].shape[1]}); raise the layer's "
                     "max_cache or rnn_clear_previous_state()")
         else:

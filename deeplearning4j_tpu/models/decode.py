@@ -1,0 +1,144 @@
+"""On-device autoregressive generation: prefill + ``lax.scan`` decode.
+
+``utils.sampling.sample_sequence`` mirrors the reference's host-side
+sampling loop (the DL4J GravesLSTM example's ``sampleCharactersFromNetwork``
+over ``rnnTimeStep``) — one dispatch per token, which on a tunneled TPU is
+dominated by round-trip latency.  This module is the TPU-native fast path:
+the whole generation — prompt prefill, per-token forward through the KV
+caches / recurrent carries, logit filtering, and the categorical draw — is
+ONE jitted XLA program, with the token loop as ``lax.scan``.  Decode cost
+is then what the hardware actually charges: streaming the KV cache through
+HBM (the bandwidth GQA and rolling-window caches exist to shrink).
+
+Works for both model families exactly like ``rnn_time_step``: attention
+layers carry KV caches, recurrent layers carry hidden state.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.utils.sampling import _filter_logits
+
+
+def _sampler(temperature: float, top_k: Optional[int], top_p: Optional[float]):
+    """Static sampling policy -> pure (logits [B, V], key) -> ids [B]."""
+    if temperature and temperature > 0:
+
+        def sample(logits, key):
+            logits = logits / jnp.asarray(temperature, logits.dtype)
+            return jax.random.categorical(
+                key, _filter_logits(logits, top_k, top_p), axis=-1)
+    else:
+
+        def sample(logits, key):
+            return jnp.argmax(logits, axis=-1)
+
+    return sample
+
+
+def build_decode_fn(net, steps: int, *, temperature: float = 1.0,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None,
+                    one_hot: bool = False,
+                    vocab_size: Optional[int] = None):
+    """Pure generation function for ``net`` (jit it once, call many times).
+
+    Returns ``fn(params, net_state, carries, prompt, rng) -> (ids, carries)``
+    where ``prompt`` is [B, T_prompt] int ids, ``carries`` are freshly
+    seeded streaming caches (see ``models.common.seed_stream_caches``; may
+    be ``{}`` for purely recurrent nets), and ``ids`` is the [B, steps]
+    sampled continuation.  The first token is drawn from the prompt's last
+    logits; each subsequent token from its predecessor's logits.
+    """
+    if steps < 1:
+        raise ValueError(f"steps={steps} must be >= 1")
+    if one_hot and vocab_size is None:
+        raise ValueError("one_hot decoding needs vocab_size")
+    sample = _sampler(temperature, top_k, top_p)
+
+    def encode(tok):
+        # tok: [B] ids -> one network step of input
+        if one_hot:
+            return jax.nn.one_hot(tok, vocab_size, dtype=jnp.float32)[:, None]
+        return tok[:, None]
+
+    def fn(params, net_state, carries, prompt, rng):
+        x = (jax.nn.one_hot(prompt, vocab_size, dtype=jnp.float32)
+             if one_hot else prompt)
+        pre, _, _, carries = net._forward(
+            params, net_state, x, train=False, rng=None,
+            carries=carries or None)
+        logits0 = pre[:, -1].astype(jnp.float32)
+        keys = jax.random.split(rng, steps)
+        tok0 = sample(logits0, keys[0])
+
+        def step(carry, key):
+            tok, carries = carry
+            pre, _, _, carries = net._forward(
+                params, net_state, encode(tok), train=False, rng=None,
+                carries=carries)
+            tok = sample(pre[:, -1].astype(jnp.float32), key)
+            return (tok, carries), tok
+
+        if steps == 1:
+            return tok0[:, None], carries
+        (_, carries), rest = lax.scan(step, (tok0, carries), keys[1:])
+        ids = jnp.concatenate([tok0[None], rest], axis=0)   # [steps, B]
+        return jnp.transpose(ids), carries
+
+    return fn
+
+
+def generate(net, prompt_ids, steps: int, *, temperature: float = 1.0,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             rng: Optional[jax.Array] = None,
+             one_hot: Optional[bool] = None,
+             vocab_size: Optional[int] = None) -> np.ndarray:
+    """Generate ``steps`` tokens after ``prompt_ids`` — same contract as
+    ``utils.sampling.sample_sequence`` but compiled end-to-end (the whole
+    loop is one XLA program; per-token Python dispatch is gone).
+
+    The decode function is cached on the net per (steps, sampling policy,
+    prompt shape), so repeated calls skip retracing.
+    """
+    from deeplearning4j_tpu.models.common import (
+        check_cache_capacity, seed_stream_caches,
+    )
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.utils.sampling import _resolve_encoding
+
+    if not isinstance(net, MultiLayerNetwork):
+        raise ValueError(
+            "generate() compiles MultiLayerNetwork._forward into the decode "
+            "scan; for a ComputationGraph use "
+            "utils.sampling.sample_sequence (host streaming loop)")
+    prompt_ids, one_hot, vocab_size = _resolve_encoding(
+        net, prompt_ids, one_hot, vocab_size)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    b, t_prompt = prompt_ids.shape
+    carries = seed_stream_caches(
+        ((l.name, l) for l in net.layers), {}, b, net.conf.compute_dtype)
+    # the WHOLE generation must fit the linear caches; checked host-side
+    # once — no per-token position sync (rolling caches never overflow)
+    check_cache_capacity(carries, t_prompt + steps, pos=0)
+
+    key = ("decode", steps, temperature, top_k, top_p, one_hot, vocab_size,
+           b, t_prompt)
+    jitted = net._jit_cache.get(key)
+    if jitted is None:
+        jitted = jax.jit(build_decode_fn(
+            net, steps, temperature=temperature, top_k=top_k, top_p=top_p,
+            one_hot=one_hot, vocab_size=vocab_size))
+        net._jit_cache[key] = jitted
+    ids, _ = jitted(net.params, net.net_state, carries,
+                    jnp.asarray(prompt_ids), rng)
+    return np.asarray(ids)

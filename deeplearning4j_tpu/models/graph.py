@@ -289,8 +289,10 @@ class ComputationGraph(LazyScoreMixin):
         self._jit_cache: Dict[Any, Any] = {}
         # output-layer nodes in declared output order
         self.output_nodes = [self.nodes[o] for o in conf.outputs]
-        # streaming rnnTimeStep state: node name -> carry
+        # streaming rnnTimeStep state: node name -> carry; _stream_pos is
+        # the host-side mirror of the caches' device position scalar
         self._rnn_state: Dict[str, Any] = {}
+        self._stream_pos: int = 0
 
     @property
     def layers(self):
@@ -685,6 +687,7 @@ class ComputationGraph(LazyScoreMixin):
     def rnn_clear_previous_state(self):
         """Reference ``ComputationGraph.rnnClearPreviousState`` :1686."""
         self._rnn_state = {}
+        self._stream_pos = 0
 
     def _id_consumer(self, input_name: str):
         """The EmbeddingLayer consuming this graph input, if any — its
@@ -735,6 +738,8 @@ class ComputationGraph(LazyScoreMixin):
             expanded[name] = v
         inputs = expanded
         first = next(iter(inputs.values()))
+        if not self._rnn_state:
+            self._stream_pos = 0
         carries = seed_stream_caches(
             ((n, self.nodes[n].layer) for n in self.topo
              if self.nodes[n].layer is not None),
@@ -743,13 +748,15 @@ class ComputationGraph(LazyScoreMixin):
         # cache may be asked to append this call
         t_new = max((int(v.shape[1]) for v in inputs.values()
                      if v.ndim >= 2), default=1)
-        check_cache_capacity(carries, t_new)
+        # host-side position counter: no device->host sync per streamed chunk
+        check_cache_capacity(carries, t_new, pos=self._stream_pos)
         carries = carries or None
         acts, _, new_carries = self._forward(
             self.params, self.net_state, inputs, train=False, rng=None,
             fmask=fmask, carries=carries,
         )
         self._rnn_state = new_carries
+        self._stream_pos += t_new
         from deeplearning4j_tpu.nn import activations
 
         outs = []

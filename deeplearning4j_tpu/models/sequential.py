@@ -48,8 +48,10 @@ class MultiLayerNetwork(LazyScoreMixin):
         self._score = None  # lazy score_value (LazyScoreMixin)
         self._keys = KeyStream(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
-        # streaming rnnTimeStep state: layer_name -> carry
+        # streaming rnnTimeStep state: layer_name -> carry; _stream_pos is
+        # the host-side mirror of the caches' device position scalar
         self._rnn_state: Dict[str, Any] = {}
+        self._stream_pos: int = 0
 
     # ------------------------------------------------------------------ init
     def init(self, dtype=jnp.float32) -> "MultiLayerNetwork":
@@ -358,6 +360,7 @@ class MultiLayerNetwork(LazyScoreMixin):
     # ------------------------------------------------- streaming rnnTimeStep
     def rnn_clear_previous_state(self):
         self._rnn_state = {}
+        self._stream_pos = 0
 
     def _embeds_ids(self) -> bool:
         """First layer consumes integer token ids (EmbeddingLayer), so a
@@ -394,15 +397,19 @@ class MultiLayerNetwork(LazyScoreMixin):
             squeeze = x.ndim == 2          # [B, F]: one timestep of features
             if squeeze:
                 x = x[:, None, :]
+        if not self._rnn_state:
+            self._stream_pos = 0
         carries = seed_stream_caches(
             ((l.name, l) for l in self.layers), self._rnn_state,
             x.shape[0], self.conf.compute_dtype)
-        check_cache_capacity(carries, int(x.shape[1]))
+        # host-side position counter: no device->host sync per streamed chunk
+        check_cache_capacity(carries, int(x.shape[1]), pos=self._stream_pos)
         carries = carries or None
         pre, _, _, new_carries = self._forward(
             self.params, self.net_state, x, train=False, rng=None, carries=carries
         )
         self._rnn_state = new_carries
+        self._stream_pos += int(x.shape[1])
         from deeplearning4j_tpu.nn import activations
 
         out = activations.get(self.layers[-1].activation)(pre)

@@ -339,7 +339,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         compute_dtype: Optional[str] = None,
                         rope: bool = True,
                         n_kv_heads: Optional[int] = None,
-                        window: Optional[int] = None) -> MultiLayerNetwork:
+                        window: Optional[int] = None,
+                        max_cache: int = 1024) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
@@ -377,7 +378,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
             SelfAttentionLayer(n_in=d_model, n_out=d_model,
                                n_heads=n_heads, causal=True,
                                seq_axis=seq_axis, rope=rope,
-                               n_kv_heads=n_kv_heads, window=window),
+                               n_kv_heads=n_kv_heads, window=window,
+                               max_cache=max_cache),
         )))
         b.layer(ResidualBlock(remat=remat, layers=(
             LayerNorm(n_in=d_model),

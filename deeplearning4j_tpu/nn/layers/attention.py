@@ -248,14 +248,20 @@ class SelfAttentionLayer(Layer):
         return cache
 
     @staticmethod
-    def cache_overflow(carry, t_new: int) -> bool:
+    def cache_overflow(carry, t_new: int, pos: Optional[int] = None) -> bool:
         """Would appending ``t_new`` steps exceed the cache?  Checked
         host-side before dispatch: ``dynamic_update_slice`` CLAMPS an
         out-of-range start index, which would silently relocate keys.
-        Rolling (windowed) caches never overflow."""
+        Rolling (windowed) caches never overflow.
+
+        ``pos`` is the host-side stream position the facades track; when
+        omitted, falls back to syncing the device scalar (fine for one-off
+        checks, a per-token round-trip in a decode loop)."""
         if "kpos" in carry:
             return False
-        return int(carry["pos"]) + t_new > carry["k"].shape[1]
+        if pos is None:
+            pos = int(carry["pos"])
+        return pos + t_new > carry["k"].shape[1]
 
     def apply_with_carry(self, params, state, x, carry, *, train=False,
                          rng=None, mask=None):

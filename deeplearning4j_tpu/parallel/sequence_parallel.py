@@ -165,7 +165,10 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
     # through the flash helper seam as SelfAttentionLayer does; the helper
     # owns the policy (under shard_map only the compiled path qualifies)
     helper = get_helper("attention")
+    # flash helper is MHA-only (its to_bh reshape assumes k/v share q's
+    # head count) — GQA (H_kv < H) must take the grouped einsum path
     if (helper is not None and qh.dtype != jnp.float64
+            and kh.shape[2] == qh.shape[2]
             and helper.supports(qh.shape[1], qh.shape[3],
                                 under_shard_map=True)):
         o = helper.attend(qh, kh, vh, causal=causal, window=window)

@@ -46,6 +46,36 @@ def _filter_logits(logits: jax.Array, top_k: Optional[int],
     return logits
 
 
+def _resolve_encoding(net, prompt_ids, one_hot: Optional[bool],
+                      vocab_size: Optional[int]):
+    """Shared preamble for the host sampling loop and on-device generate:
+    validate the prompt and resolve the input encoding.  Auto-detection
+    works for sequential nets only (a ComputationGraph also exposes
+    ``.layers``, but in topological order — the first entry need not be
+    the input layer, so auto-detect would silently guess wrong; CG callers
+    must pass ``one_hot=`` explicitly)."""
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
+
+    prompt_ids = np.asarray(prompt_ids)
+    if prompt_ids.ndim != 2:
+        raise ValueError(f"prompt_ids must be [B, T], got {prompt_ids.shape}")
+    sequential = isinstance(net, MultiLayerNetwork)
+    if one_hot is None:
+        if not sequential:
+            raise ValueError(
+                "one_hot auto-detection needs a MultiLayerNetwork; pass "
+                "one_hot= explicitly for a ComputationGraph")
+        one_hot = not (net.layers
+                       and isinstance(net.layers[0], EmbeddingLayer))
+    if one_hot and vocab_size is None:
+        if not sequential:
+            raise ValueError("pass vocab_size= explicitly for a "
+                             "ComputationGraph with one_hot inputs")
+        vocab_size = net.layers[-1].n_out
+    return prompt_ids, one_hot, vocab_size
+
+
 def sample_sequence(net, prompt_ids, steps: int, *,
                     temperature: float = 1.0,
                     top_k: Optional[int] = None,
@@ -63,24 +93,8 @@ def sample_sequence(net, prompt_ids, steps: int, *,
     ``top_p`` (nucleus) filter the distribution before sampling.
     Returns the sampled ids [B, steps].
     """
-    from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
-
-    prompt_ids = np.asarray(prompt_ids)
-    if prompt_ids.ndim != 2:
-        raise ValueError(f"prompt_ids must be [B, T], got {prompt_ids.shape}")
-    layers = getattr(net, "layers", None)   # MLN only; CG has named nodes
-    if one_hot is None:
-        if layers is None:
-            raise ValueError(
-                "one_hot auto-detection needs a sequential net with "
-                ".layers (MultiLayerNetwork); pass one_hot= explicitly "
-                "for a ComputationGraph")
-        one_hot = not (layers and isinstance(layers[0], EmbeddingLayer))
-    if one_hot and vocab_size is None:
-        if layers is None:
-            raise ValueError("pass vocab_size= explicitly for a "
-                             "ComputationGraph with one_hot inputs")
-        vocab_size = layers[-1].n_out
+    prompt_ids, one_hot, vocab_size = _resolve_encoding(
+        net, prompt_ids, one_hot, vocab_size)
     if rng is None:
         rng = jax.random.PRNGKey(0)
 

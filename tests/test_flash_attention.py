@@ -233,6 +233,26 @@ def test_streaming_cache_overflow_raises():
         check_cache_capacity({"blk": {"sub1": carry}}, 2)
 
 
+def test_streaming_overflow_via_facade_host_counter():
+    """The facade tracks the stream position HOST-side (_stream_pos) so the
+    per-chunk capacity check never syncs the device scalar; overflow must
+    still raise at exactly the right chunk, and clearing state resets it."""
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    net = transformer_char_lm(vocab_size=8, d_model=8, n_heads=2, layers=1,
+                              max_cache=4)
+    ids = np.zeros((2, 3), np.int64)
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(jnp.asarray(ids))            # pos 0 -> 3
+    assert net._stream_pos == 3
+    with pytest.raises(ValueError, match="max_cache"):
+        net.rnn_time_step(jnp.asarray(ids))        # 3 + 3 > 4
+    net.rnn_clear_previous_state()
+    assert net._stream_pos == 0
+    net.rnn_time_step(jnp.asarray(ids))            # fits again after reset
+    assert net._stream_pos == 3
+
+
 def test_streaming_requires_causal_unmasked():
     """The cache path refuses non-causal layers and padding masks instead
     of silently computing different activations than output()."""

@@ -68,6 +68,52 @@ def test_ulysses_declines_flash_off_tpu(causal):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_ulysses_gqa_bypasses_flash_helper():
+    """GQA k/v (H_kv < H) must never reach the flash helper from the
+    Ulysses path — the helper's to_bh reshape assumes k/v share q's head
+    count, so on TPU an eligible-looking GQA call would crash instead of
+    falling back to the grouped einsum (advisor finding, round 3)."""
+    from deeplearning4j_tpu import helpers
+
+    class EagerSpyHelper:
+        """Claims support unconditionally (as the real helper does compiled
+        on TPU) and records whether it was consulted with GQA shapes."""
+
+        def __init__(self):
+            self.attend_heads = []
+
+        def supports(self, t, d, *, under_shard_map=False):
+            return True
+
+        def attend(self, q, k, v, *, causal=False, window=None):
+            self.attend_heads.append((q.shape[2], k.shape[2]))
+            return dot_product_attention(q, k, v, causal=causal,
+                                         window=window)
+
+    rng = np.random.default_rng(3)
+    # 2 shards: Ulysses all_to_all needs H_kv % n_shards == 0
+    b, t, h, hkv, d = 1, 64, 4, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, t, h, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, t, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, t, hkv, d)), jnp.float32)
+    mesh = _seq_mesh(2)
+    spy = EagerSpyHelper()
+    helpers.register_helper("attention", spy)
+    try:
+        got = ring_self_attention(q, k, v, mesh, causal=True, impl="ulysses")
+        # MHA control: same helper IS consulted when head counts agree
+        qm = jnp.asarray(rng.standard_normal((b, t, hkv, d)), jnp.float32)
+        ring_self_attention(qm, k, v, mesh, causal=True, impl="ulysses")
+    finally:
+        helpers._registry.pop("attention", None)
+    assert all(hq == hk for hq, hk in spy.attend_heads), (
+        f"flash helper consulted with GQA head mismatch: {spy.attend_heads}")
+    assert spy.attend_heads, "MHA control never reached the helper"
+    expected = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_ring_attention_gradients_match_exact():
     rng = np.random.default_rng(1)
     q, k, v = _qkv(rng, b=1, t=16, h=2, d=4)
