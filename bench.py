@@ -219,6 +219,13 @@ def _checked_time(run_one, warmup, iters, block, flops, peak, reps=3):
     if flops and peak and flops / dt > peak:
         dt = max(dt, _time_loop_synced(run_one, max(5, iters // 4), block))
         mode = "synced"
+        # the chained reps were just rejected as physically impossible —
+        # their spread stats must not be paired with the synced median
+        spread = {"reps": spread["reps"], "iqr_ms": None, "rel_iqr": None,
+                  "noisy": None,
+                  "rejected_chained_rep_ms": spread["rep_ms"],
+                  "note": "chained reps implied FLOP/s > peak; "
+                          "re-measured hard-synced, spread n/a"}
     return dt, mode, spread
 
 

@@ -42,6 +42,25 @@ _GLYPHS = {
 }
 
 
+def write_idx(path: Path, array: np.ndarray) -> None:
+    """Write ``array`` as an IDX (ubyte) file — the MNIST binary layout the
+    reference downloads and parses (``deeplearning4j-core/.../base/
+    MnistFetcher.java:35``, binary readers ``datasets/mnist/
+    MnistManager.java`` + ``MnistImageFile/MnistLabelFile``): 2 zero bytes,
+    dtype code 0x08 (unsigned byte), ndim, big-endian uint32 dims, raw
+    data.  A ``.gz`` suffix gzips the stream (as the reference's fetcher
+    stores them).  This is the hermetic inverse of ``_read_idx`` — it lets
+    tests and offline rigs exercise the REAL parse branch
+    (``is_synthetic=False``) without network egress."""
+    path = Path(path)
+    array = np.ascontiguousarray(array, np.uint8)
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, array.ndim))
+        f.write(struct.pack(">" + "I" * array.ndim, *array.shape))
+        f.write(array.tobytes())
+
+
 def _read_idx(path: Path) -> np.ndarray:
     opener = gzip.open if path.suffix == ".gz" else open
     with opener(path, "rb") as f:

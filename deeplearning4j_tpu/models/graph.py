@@ -291,8 +291,10 @@ class ComputationGraph(LazyScoreMixin):
         self.output_nodes = [self.nodes[o] for o in conf.outputs]
         # streaming rnnTimeStep state: node name -> carry; _stream_pos is
         # the host-side mirror of the caches' device position scalar
+        # (None = poisoned by unequal per-input chunk lengths -> the
+        # capacity check syncs device positions instead)
         self._rnn_state: Dict[str, Any] = {}
-        self._stream_pos: int = 0
+        self._stream_pos: Optional[int] = 0
 
     @property
     def layers(self):
@@ -746,17 +748,25 @@ class ComputationGraph(LazyScoreMixin):
             self._rnn_state, first.shape[0], self.conf.compute_dtype)
         # the longest time axis across inputs bounds what any attention
         # cache may be asked to append this call
-        t_new = max((int(v.shape[1]) for v in inputs.values()
-                     if v.ndim >= 2), default=1)
-        # host-side position counter: no device->host sync per streamed chunk
-        check_cache_capacity(carries, t_new, pos=self._stream_pos)
+        t_all = {int(v.shape[1]) for v in inputs.values() if v.ndim >= 2}
+        t_new = max(t_all, default=1)
+        # host-side position counter: no device->host sync per streamed
+        # chunk.  Valid only while every input streams the same number of
+        # timesteps per call (caches fed by a shorter input would advance
+        # less than the counter) — unequal chunks poison the counter and
+        # the check falls back to syncing each cache's device position.
+        if len(t_all) > 1:
+            self._stream_pos = None
+        pos = self._stream_pos if isinstance(self._stream_pos, int) else None
+        check_cache_capacity(carries, t_new, pos=pos)
         carries = carries or None
         acts, _, new_carries = self._forward(
             self.params, self.net_state, inputs, train=False, rng=None,
             fmask=fmask, carries=carries,
         )
         self._rnn_state = new_carries
-        self._stream_pos += t_new
+        if isinstance(self._stream_pos, int):
+            self._stream_pos += t_new
         from deeplearning4j_tpu.nn import activations
 
         outs = []

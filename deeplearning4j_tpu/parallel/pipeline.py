@@ -17,11 +17,28 @@ Two execution paths:
   reverse rotation).  Non-periodic head/tail layers run replicated, with
   their contributions masked to stage 0 / stage S-1 and grads psum'd.
 
-- **Orchestrated** (generality fallback): heterogeneous stacks run the
-  schedule as per-stage ``jax.vjp`` calls with explicit device placement —
-  correct for any stateless sequential net, at interpreter dispatch cost.
+- **Compiled heterogeneous** (new, round 4): NON-periodic stacks (the
+  conv-then-dense case) also compile to one XLA program.  Under SPMD every
+  device must run the same program, so the per-stage functions live in a
+  ``lax.switch`` on ``lax.axis_index('pipe')``, and inter-stage activations
+  — whose shapes differ between boundaries — travel as a flat buffer padded
+  to the largest boundary, reshaped by each stage's branch.  Params stay
+  REPLICATED (heterogeneous per-stage pytrees cannot be stacked along a
+  mesh axis), so this trades the periodic path's param-memory partitioning
+  for full generality while keeping the one-program schedule; gradients
+  are nonzero only on the executing stage's branch and ``psum`` over the
+  pipe axis reassembles them.
 
-Scope (both paths): sequential stateless nets (no BatchNorm running
+- **Orchestrated** (explicit opt-in / fallback): per-stage ``jax.vjp``
+  calls with real per-device param placement — partitions param memory for
+  any net, at interpreter dispatch cost.  Supports both schedules:
+  ``schedule='gpipe'`` (all forwards, then all backwards — M in-flight
+  pullbacks) and ``schedule='1f1b'`` (backward of microbatch m follows its
+  forward after the S-1 fill, PipeDream-flush style — at most S in-flight
+  pullbacks, the activation-memory win; the bubble fraction is the same
+  (S-1)/(M+S-1) as GPipe for non-interleaved stages).
+
+Scope (all paths): sequential stateless nets (no BatchNorm running
 stats, no masks, no TBPTT, no dropout).  Compose with DP/TP via those
 masters; this one owns the pipe axis.
 """
@@ -101,17 +118,79 @@ def find_periodic_run(sigs: List[str], n_stages: int) -> Optional[Tuple[int, int
     return best
 
 
+def measure_bubble_fraction(make_net, make_batch, n_stages: int,
+                            mb_size: int, m_small: int = 2,
+                            m_large: int = 8, iters: int = 5,
+                            devices: Optional[Sequence] = None,
+                            mode: str = "auto") -> Dict[str, float]:
+    """Measured pipeline bubble on a real mesh (the analytic counterpart is
+    ``PipelineParallelTrainingMaster.bubble_fraction``).
+
+    Holds the microbatch SIZE fixed and times steady-state steps at two
+    microbatch COUNTS: t(M) ≈ (M + S - 1)·tick + c, so the slope between
+    the two isolates the per-tick cost and ``(t - M·tick) / t`` is the
+    fraction of the step not doing useful microbatch work (fill/drain
+    bubble + fixed overhead c — updater, reg, dispatch; both are honest
+    non-useful time).  ``make_net() -> net``, ``make_batch(n) -> DataSet``.
+    """
+    import time as _time
+
+    def run(M):
+        net = make_net()
+        master = PipelineParallelTrainingMaster(
+            n_stages=n_stages, n_microbatches=M, devices=devices, mode=mode)
+        ds = make_batch(M * mb_size)
+        master.execute_training(net, [ds])      # build + compile
+        float(net.score_value)                  # block
+        t0 = _time.perf_counter()
+        master.execute_training(net, [ds] * iters)
+        float(net.score_value)
+        return (_time.perf_counter() - t0) / iters, master
+
+    t_small, _ = run(m_small)
+    t_large, master = run(m_large)
+    tick = (t_large - t_small) / (m_large - m_small)
+    measured = (t_large - m_large * tick) / t_large if t_large > 0 else 0.0
+    return {
+        "n_stages": n_stages,
+        "mode": master._mode,
+        "m_small": m_small, "m_large": m_large,
+        "t_small_ms": round(t_small * 1e3, 3),
+        "t_large_ms": round(t_large * 1e3, 3),
+        "tick_ms": round(tick * 1e3, 3),
+        "bubble_measured": round(measured, 4),
+        "bubble_analytic": round(master.bubble_fraction(), 4),
+    }
+
+
 class PipelineParallelTrainingMaster(TrainingMaster):
     def __init__(self, n_stages: Optional[int] = None,
                  n_microbatches: int = 4,
-                 devices: Optional[Sequence] = None):
+                 devices: Optional[Sequence] = None,
+                 schedule: str = "gpipe",
+                 mode: str = "auto"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"schedule={schedule!r}: use 'gpipe' or '1f1b'")
+        if mode not in ("auto", "compiled", "orchestrated"):
+            raise ValueError(
+                f"mode={mode!r}: use 'auto', 'compiled' or 'orchestrated'")
         self.devices = list(devices if devices is not None else jax.devices())
         self.n_stages = n_stages or len(self.devices)
         if self.n_stages > len(self.devices):
             raise ValueError(
                 f"{self.n_stages} stages > {len(self.devices)} devices")
         self.n_microbatches = n_microbatches
+        self.schedule = schedule
+        self.mode = mode
         self._built = False
+
+    def bubble_fraction(self) -> float:
+        """Analytic pipeline bubble: of the M + S - 1 schedule ticks, S - 1
+        are fill/drain — identical for GPipe and non-interleaved 1F1B (1F1B
+        buys activation MEMORY, not bubble).  Measured counterpart:
+        ``measure_bubble_fraction``."""
+        s = self.n_stages
+        return (s - 1) / (self.n_microbatches + s - 1)
 
     # ------------------------------------------------------------ validation
     def _validate(self, net):
@@ -132,42 +211,67 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         cfg = net.conf.updater
         lr_overrides = {l.name: l.learning_rate for l in net.layers
                         if l.learning_rate is not None}
-        if (self.n_stages > 1 and not lr_overrides
-                and cfg.gradient_normalization in (None, "none")):
-            run = find_periodic_run([_layer_sig(l) for l in net.layers],
-                                    self.n_stages)
-            if run is not None and run[0] + run[1] * run[2] < len(net.layers):
-                self._build_compiled(net, run)
+        if self.mode == "compiled" and self.n_stages < 2:
+            raise ValueError("mode='compiled' needs n_stages >= 2 "
+                             f"(got {self.n_stages})")
+        if self.mode != "orchestrated" and self.n_stages > 1:
+            # best path: periodic run -> stacked params SHARDED stage-per-
+            # device (param memory partitioned)
+            if (not lr_overrides
+                    and cfg.gradient_normalization in (None, "none")):
+                run = find_periodic_run([_layer_sig(l) for l in net.layers],
+                                        self.n_stages)
+                if (run is not None
+                        and run[0] + run[1] * run[2] < len(net.layers)):
+                    self._build_compiled(net, run)
+                    self._built = True
+                    return
+            # heterogeneous stacks still compile (switch-per-stage, padded
+            # activation buffer, replicated params — module docstring)
+            from deeplearning4j_tpu.nn.layers.dense import OutputLayer as _O
+
+            if isinstance(net.layers[-1], _O):
+                self._build_compiled_hetero(net)
                 self._built = True
                 return
+            if self.mode == "compiled":
+                raise ValueError(
+                    "mode='compiled' needs the net to end in an OutputLayer")
         self.stages = split_stages(net, self.n_stages)
         self.stage_layers = [[net.layers[i] for i in s] for s in self.stages]
         out_layer = net.layers[-1]
+        pre = net.conf.preprocessors
 
-        def make_stage_fwd(layers):
+        def make_stage_fwd(idxs, layers):
             def fwd(stage_params, a):
-                for layer in layers:
-                    if layer.has_params():
-                        a, _ = layer.apply(stage_params[layer.name], {}, a,
-                                           train=True, rng=None)
-                    else:
-                        a, _ = layer.apply({}, {}, a, train=True, rng=None)
+                for gi, layer in zip(idxs, layers):
+                    if gi in pre:
+                        a = pre[gi](a)
+                    a, _ = layer.apply(
+                        stage_params[layer.name] if layer.has_params() else {},
+                        {}, a, train=True, rng=None)
                 return a
             return fwd
 
-        def make_last_stage(layers):
-            body = layers[:-1]
+        def make_last_stage(idxs, layers):
+            body = list(zip(idxs[:-1], layers[:-1]))
 
             def fwd_loss(stage_params, a, y):
-                for layer in body:
+                for gi, layer in body:
+                    if gi in pre:
+                        a = pre[gi](a)
                     p = stage_params.get(layer.name, {})
                     a, _ = layer.apply(p, {}, a, train=True, rng=None)
+                if idxs[-1] in pre:
+                    a = pre[idxs[-1]](a)
                 return out_layer.score(stage_params[out_layer.name], a, y)
             return fwd_loss
 
-        self._stage_fwds = [jax.jit(make_stage_fwd(ls))
-                            for ls in self.stage_layers[:-1]]
-        self._last_stage = jax.jit(make_last_stage(self.stage_layers[-1]))
+        self._stage_fwds = [jax.jit(make_stage_fwd(idxs, ls))
+                            for idxs, ls in zip(self.stages[:-1],
+                                                self.stage_layers[:-1])]
+        self._last_stage = jax.jit(make_last_stage(self.stages[-1],
+                                                   self.stage_layers[-1]))
         self._reg_fns = [
             jax.jit(jax.value_and_grad(lambda sp, ls=ls: sum(
                 layer.reg_score(sp.get(layer.name, {})) for layer in ls)))
@@ -208,7 +312,167 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         self._repl_sharding = NamedSharding(self._mesh, P())
         self._upd_cfg = net.conf.updater
         self._mode = "compiled"
+        self._compiled_kind = "periodic"
         self._compiled_steps = {}  # (xs.shape, ys.shape) -> jitted step
+
+    # ------------------------------------- compiled heterogeneous schedule
+    def _build_compiled_hetero(self, net):
+        """One-XLA-program GPipe for NON-periodic stacks: stage bodies in a
+        ``lax.switch`` on the pipe index, boundary activations in a flat
+        padded buffer, params replicated (see module docstring)."""
+        self.stages = split_stages(net, self.n_stages)
+        self.stage_layers = [[net.layers[i] for i in s] for s in self.stages]
+        S = len(self.stages)
+        self.n_stages = S
+        self._mesh = Mesh(np.asarray(self.devices[:S]), ("pipe",))
+        self._repl_sharding = NamedSharding(self._mesh, P())
+        self._upd_cfg = net.conf.updater
+        self._lr_overrides = {l.name: l.learning_rate for l in net.layers
+                              if l.learning_rate is not None}
+        self._mode = "compiled"
+        self._compiled_kind = "hetero"
+        self._compiled_steps = {}
+
+    def _make_hetero_step(self, net, x_mb_shape, x_dtype):
+        S = len(self.stage_layers)
+        M = self.n_microbatches
+        cfg = self._upd_cfg
+        stage_layers = self.stage_layers
+        stage_idxs = self.stages
+        out_layer = stage_layers[-1][-1]
+        pre = net.conf.preprocessors
+
+        def stage_fwd(s, tree, a):
+            n = len(stage_layers[s]) - (1 if s == S - 1 else 0)
+            for j in range(n):
+                gi = stage_idxs[s][j]
+                if gi in pre:
+                    a = pre[gi](a)
+                a, _ = stage_layers[s][j].apply(
+                    tree.get(stage_layers[s][j].name, {}), {}, a,
+                    train=True, rng=None)
+            if s == S - 1 and stage_idxs[s][-1] in pre:
+                a = pre[stage_idxs[s][-1]](a)   # preprocessor feeding the head
+            return a
+
+        # boundary shapes: output of stage s == input of stage s + 1
+        bound = []
+        probe = jax.ShapeDtypeStruct(x_mb_shape, x_dtype)
+        for s in range(S - 1):
+            probe = jax.eval_shape(
+                lambda tr, a, s=s: stage_fwd(s, tr, a), net.params, probe)
+            bound.append(probe)
+        buf_dtype = jnp.result_type(*[b.dtype for b in bound])
+        buf = max(int(np.prod(b.shape)) for b in bound)
+
+        def spmd(tree, xs, ys):
+            idx = lax.axis_index("pipe")
+            perm = [(i, i + 1) for i in range(S - 1)]
+
+            def local_loss(tree):
+                def make_branch(s):
+                    def br(state, t):
+                        if s == 0:
+                            a = xs[jnp.clip(t, 0, M - 1)]
+                        else:
+                            b = bound[s - 1]
+                            n = int(np.prod(b.shape))
+                            a = state[:n].reshape(b.shape).astype(b.dtype)
+                        a = stage_fwd(s, tree, a)
+                        if s == S - 1:
+                            m_out = t - (S - 1)
+                            l = out_layer.score(
+                                tree.get(out_layer.name, {}), a,
+                                ys[jnp.clip(m_out, 0, M - 1)])
+                            return (jnp.zeros((buf,), buf_dtype),
+                                    l.astype(jnp.float32))
+                        flat = a.reshape(-1).astype(buf_dtype)
+                        return (jnp.pad(flat, (0, buf - flat.shape[0])),
+                                jnp.zeros((), jnp.float32))
+                    return br
+
+                branches = [make_branch(s) for s in range(S)]
+                state0 = lax.pcast(jnp.zeros((buf,), buf_dtype), ("pipe",),
+                                   to="varying")
+                loss0 = lax.pcast(jnp.zeros(()), ("pipe",), to="varying")
+
+                def tick(carry, t):
+                    state, loss_sum = carry
+                    out, l = lax.switch(idx, branches, state, t)
+                    m_out = t - (S - 1)
+                    loss_sum = loss_sum + jnp.where(
+                        (idx == S - 1) & (m_out >= 0), l, 0.0)
+                    state = lax.ppermute(out, "pipe", perm)
+                    return (state, loss_sum), None
+
+                (_, loss_sum), _ = lax.scan(
+                    tick, (state0, loss0), jnp.arange(M + S - 1))
+                # LOCAL loss only (nonzero on the last stage); grads are
+                # nonzero only for the executing stage's branch — the psum
+                # below reassembles the full tree without double counting
+                return loss_sum / M
+
+            loss, grads = jax.value_and_grad(local_loss)(tree)
+            return lax.psum(loss, "pipe"), lax.psum(grads, "pipe")
+
+        repl = P()
+        sharded = shard_map(spmd, mesh=self._mesh,
+                            in_specs=(repl, repl, repl),
+                            out_specs=(repl, repl), check_vma=False)
+        reg_layers = [l for ls in stage_layers for l in ls if l.has_params()]
+
+        def reg_fn(tree):
+            r = jnp.zeros(())
+            for l in reg_layers:
+                r = r + l.reg_score(tree.get(l.name, {}))
+            return r
+
+        lr_overrides = self._lr_overrides
+
+        def step(tree, opt_state, it, xs, ys):
+            loss, grads = sharded(tree, xs, ys)
+            reg_val, reg_g = jax.value_and_grad(reg_fn)(tree)
+            grads = {k: v for k, v in grads.items() if v}
+            grads = jax.tree_util.tree_map(
+                jnp.add, grads, {k: reg_g[k] for k in grads})
+            updates, new_opt = upd.update(cfg, grads, opt_state, it,
+                                          lr_overrides, params=tree)
+            new_tree = {
+                k: (upd.apply_updates(v, u)
+                    if (u := updates.get(k)) else v)
+                for k, v in tree.items()
+            }
+            return new_tree, new_opt, loss + reg_val
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _execute_hetero(self, net, iterator):
+        M = self.n_microbatches
+        tree = jax.device_put(net.params, self._repl_sharding)
+        opt_state = jax.device_put(net.updater_state, self._repl_sharding)
+        for ds in iterator:
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                raise ValueError(
+                    "pipeline master does not support masked batches")
+            x = np.asarray(ds.features)
+            y = np.asarray(ds.labels)
+            if len(x) % M:
+                raise ValueError(f"batch {len(x)} not divisible by "
+                                 f"{M} microbatches")
+            xs = jnp.asarray(x.reshape((M, len(x) // M) + x.shape[1:]))
+            ys = jnp.asarray(y.reshape((M, len(y) // M) + y.shape[1:]))
+            key = (xs.shape, ys.shape)
+            if key not in self._compiled_steps:
+                self._compiled_steps[key] = self._make_hetero_step(
+                    net, xs.shape[1:], xs.dtype)
+            tree, opt_state, loss = self._compiled_steps[key](
+                tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
+            net.score_value = loss
+            net.iteration += 1
+            for lst in net.listeners:
+                lst.iteration_done(net, net.iteration)
+        net.params = tree
+        net.updater_state = opt_state
 
     # --- facade <-> pipeline param tree conversion (keys: pfx/ blk/ sfx/)
     def _stack_tree(self, per_layer: Dict[str, Any]) -> Dict[str, Any]:
@@ -388,6 +652,8 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         if not self._built:
             self._build(net)
         if self._mode == "compiled":
+            if self._compiled_kind == "hetero":
+                return self._execute_hetero(net, iterator)
             return self._execute_compiled(net, iterator)
         S = len(self.stages)
         # place each stage's params + updater state on its device
@@ -434,10 +700,12 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         xs = jnp.split(x, M)
         ys = jnp.split(y, M)
 
-        # forward (fill): async dispatch overlaps (m, s) with (m+1, s-1)
         pullbacks = [[None] * S for _ in range(M)]
-        losses = []
-        for m in range(M):
+        losses = [None] * M
+        grads = [None] * S
+
+        def forward(m):
+            # async dispatch overlaps (m, s) with (m+1, s-1)
             a = jax.device_put(xs[m], self.devices[0])
             for s in range(S - 1):
                 a, vjp = jax.vjp(self._stage_fwds[s], stage_params[s], a)
@@ -447,11 +715,9 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             loss_m, vjp = jax.vjp(self._last_stage, stage_params[S - 1], a,
                                   y_m)
             pullbacks[m][S - 1] = vjp
-            losses.append(loss_m)
+            losses[m] = loss_m
 
-        # backward (drain), reverse schedule; grads accumulate per stage
-        grads = [None] * S
-        for m in range(M):
+        def backward(m):
             seed = jnp.ones((), losses[m].dtype) / M
             gp, ga, _gy = pullbacks[m][S - 1](seed)
             grads[S - 1] = gp if grads[S - 1] is None else jax.tree_util.tree_map(
@@ -461,6 +727,23 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                 gp, ga = pullbacks[m][s](ga)
                 grads[s] = gp if grads[s] is None else jax.tree_util.tree_map(
                     jnp.add, grads[s], gp)
+            pullbacks[m] = [None] * S   # release stashed activations
+
+        if self.schedule == "1f1b":
+            # PipeDream-flush: after the S-1-tick fill, each microbatch's
+            # backward follows its forward — at most S pullbacks live at
+            # once (vs M for GPipe), same (S-1)/(M+S-1) bubble
+            for t in range(M + S - 1):
+                if t < M:
+                    forward(t)
+                if t - (S - 1) >= 0:
+                    backward(t - (S - 1))
+        else:
+            # GPipe: all forwards (fill), then all backwards (drain)
+            for m in range(M):
+                forward(m)
+            for m in range(M):
+                backward(m)
 
         # regularization value+gradients + updater apply, per stage on-device
         it = jnp.asarray(float(net.iteration))

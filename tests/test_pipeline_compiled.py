@@ -130,18 +130,114 @@ def test_compiled_pipeline_handles_batch_size_change():
     assert np.isfinite(pp_net.score_value)
 
 
-def test_heterogeneous_falls_back_to_orchestrated():
-    b = (NeuralNetConfiguration.builder().seed(3)
-         .updater("sgd", learning_rate=0.1).list()
+def hetero_mlp(seed=3, lr=0.1):
+    """Non-periodic stack: every boundary has a different width, so no
+    periodic run exists — exercises the switch-based compiled path."""
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=lr).list()
          .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
          .layer(DenseLayer(n_in=16, n_out=12, activation="relu"))
          .layer(DenseLayer(n_in=12, n_out=8, activation="tanh"))
          .layer(OutputLayer(n_in=8, n_out=4)))
-    net = MultiLayerNetwork(b.build()).init()
+    return MultiLayerNetwork(b.build()).init()
+
+
+@pytest.mark.parametrize("n_stages,n_micro", [(2, 4), (4, 2)])
+def test_heterogeneous_compiles_and_matches_serial(n_stages, n_micro):
+    """Round 4: non-periodic stacks COMPILE (lax.switch stages, padded
+    activation buffer) — serial equivalence is the oracle."""
+    x, y = data(32)
+    serial = hetero_mlp()
+    serial.fit(x, y)
+    serial.fit(x, y)
+    net = hetero_mlp()
+    master = _fit_pp(net, x, y, n_stages, n_micro)
+    assert master._mode == "compiled"
+    assert master._compiled_kind == "hetero"
+    for ln in serial.params:
+        for pn in serial.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[ln][pn]),
+                np.asarray(net.params[ln][pn]), atol=2e-5,
+                err_msg=f"{ln}/{pn}")
+    assert abs(serial.score_value - net.score_value) < 1e-4
+
+
+def conv_then_dense(seed=5, lr=0.05):
+    """The conv-then-dense shape the compiled-heterogeneity work targets:
+    CNN input, conv + pooling stages, preprocessor-flattened dense head."""
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        ConvolutionLayer, SubsamplingLayer,
+    )
+
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=lr).list()
+         .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                 activation="relu"))
+         .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+         .layer(DenseLayer(n_out=16, activation="tanh"))
+         .layer(OutputLayer(n_out=4)))
+    b.set_input_type(InputType.convolutional(8, 8, 1))
+    return MultiLayerNetwork(b.build()).init()
+
+
+def test_conv_then_dense_pipeline_compiles():
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 8, 8, 1).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, 16)]
+    serial = conv_then_dense()
+    serial.fit(x, y)
+    net = conv_then_dense()
+    master = _fit_pp(net, x, y, 2, 2, epochs=1)
+    assert master._mode == "compiled"
+    assert master._compiled_kind == "hetero"
+    for ln in serial.params:
+        for pn in serial.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(serial.params[ln][pn]),
+                np.asarray(net.params[ln][pn]), atol=2e-5,
+                err_msg=f"{ln}/{pn}")
+
+
+def test_orchestrated_opt_in_and_1f1b_schedules_match_serial():
+    """mode='orchestrated' still exists (real per-device param placement),
+    and both schedules produce serial-identical math — 1F1B only reorders
+    the same vjp calls (memory, not numerics)."""
     x, y = data(16)
-    master = PipelineParallelTrainingMaster(
-        n_stages=2, n_microbatches=2, devices=jax.devices()[:2])
-    DistributedNetwork(net, master).fit(
-        ListDataSetIterator(DataSet(x, y), 16))
-    assert master._mode == "orchestrated"
-    assert np.isfinite(net.score_value)
+    for schedule in ("gpipe", "1f1b"):
+        serial = hetero_mlp(seed=21)
+        serial.fit(x, y)
+        net = hetero_mlp(seed=21)
+        master = PipelineParallelTrainingMaster(
+            n_stages=2, n_microbatches=4, devices=jax.devices()[:2],
+            mode="orchestrated", schedule=schedule)
+        DistributedNetwork(net, master).fit(
+            ListDataSetIterator(DataSet(x, y), 16))
+        assert master._mode == "orchestrated"
+        for ln in serial.params:
+            for pn in serial.params[ln]:
+                np.testing.assert_allclose(
+                    np.asarray(serial.params[ln][pn]),
+                    np.asarray(net.params[ln][pn]), atol=2e-5,
+                    err_msg=f"{schedule}: {ln}/{pn}")
+
+
+def test_bubble_fraction_analytic_and_measured():
+    from deeplearning4j_tpu.parallel.pipeline import measure_bubble_fraction
+
+    m = PipelineParallelTrainingMaster(n_stages=4, n_microbatches=4,
+                                       devices=jax.devices()[:4])
+    assert abs(m.bubble_fraction() - 3 / 7) < 1e-9
+
+    def make_batch(n):
+        x, y = data(n)
+        return DataSet(x, y)
+
+    stats = measure_bubble_fraction(
+        lambda: block_mlp(n_blocks=4, seed=17), make_batch,
+        n_stages=2, mb_size=8, m_small=2, m_large=4, iters=2,
+        devices=jax.devices()[:2])
+    assert stats["mode"] == "compiled"
+    assert 0.0 <= stats["bubble_analytic"] < 1.0
+    assert np.isfinite(stats["bubble_measured"])
