@@ -56,6 +56,13 @@ def build_decode_fn(net, steps: int, *, temperature: float = 1.0,
     be ``{}`` for purely recurrent nets), and ``ids`` is the [B, steps]
     sampled continuation.  The first token is drawn from the prompt's last
     logits; each subsequent token from its predecessor's logits.
+
+    Returned-carries contract: the caches reflect the prompt plus the first
+    ``steps - 1`` sampled tokens — the FINAL sampled token is never fed back
+    (its logits are never needed), for every ``steps`` including 1.  A
+    caller resuming generation from the returned carries must therefore
+    feed ``ids[:, -1]`` as the next input; total cache occupancy after a
+    call is ``t_prompt + steps - 1`` positions.
     """
     if steps < 1:
         raise ValueError(f"steps={steps} must be >= 1")
@@ -128,8 +135,10 @@ def generate(net, prompt_ids, steps: int, *, temperature: float = 1.0,
     carries = seed_stream_caches(
         ((l.name, l) for l in net.layers), {}, b, net.conf.compute_dtype)
     # the WHOLE generation must fit the linear caches; checked host-side
-    # once — no per-token position sync (rolling caches never overflow)
-    check_cache_capacity(carries, t_prompt + steps, pos=0)
+    # once — no per-token position sync (rolling caches never overflow).
+    # Occupancy is t_prompt + steps - 1: the final sampled token is never
+    # fed back through the cache (see build_decode_fn's carries contract).
+    check_cache_capacity(carries, t_prompt + steps - 1, pos=0)
 
     key = ("decode", steps, temperature, top_k, top_p, one_hot, vocab_size,
            b, t_prompt)

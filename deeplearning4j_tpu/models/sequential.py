@@ -248,6 +248,12 @@ class MultiLayerNetwork(LazyScoreMixin):
             raise ValueError("fit_scanned requires SGD optimization")
         if self.conf.backprop_type == "truncated_bptt":
             raise ValueError("fit_scanned does not support TBPTT")
+        if self.conf.num_iterations != 1:
+            # fit() repeats each batch num_iterations times; the scan body
+            # runs each batch once — diverging silently would betray the
+            # 'semantically identical to fit' promise above
+            raise ValueError("fit_scanned requires num_iterations == 1 "
+                             f"(got {self.conf.num_iterations})")
         scanned = self._jit_cache.setdefault(
             "scanned_step", self._make_scanned_step())
         step = self._get_train_step()

@@ -17,17 +17,20 @@ Two execution paths:
   reverse rotation).  Non-periodic head/tail layers run replicated, with
   their contributions masked to stage 0 / stage S-1 and grads psum'd.
 
-- **Compiled heterogeneous** (new, round 4): NON-periodic stacks (the
-  conv-then-dense case) also compile to one XLA program.  Under SPMD every
-  device must run the same program, so the per-stage functions live in a
-  ``lax.switch`` on ``lax.axis_index('pipe')``, and inter-stage activations
-  — whose shapes differ between boundaries — travel as a flat buffer padded
-  to the largest boundary, reshaped by each stage's branch.  Params stay
-  REPLICATED (heterogeneous per-stage pytrees cannot be stacked along a
-  mesh axis), so this trades the periodic path's param-memory partitioning
-  for full generality while keeping the one-program schedule; gradients
-  are nonzero only on the executing stage's branch and ``psum`` over the
-  pipe axis reassembles them.
+- **Compiled heterogeneous** (round 4; params sharded round 5): NON-periodic
+  stacks (the conv-then-dense case) also compile to one XLA program.  Under
+  SPMD every device must run the same program, so the per-stage functions
+  live in a ``lax.switch`` on ``lax.axis_index('pipe')``, and inter-stage
+  activations — whose shapes differ between boundaries — travel as a flat
+  buffer padded to the largest boundary, reshaped by each stage's branch.
+  Params get the same flat-buffer treatment: each stage's tree is raveled
+  into one f32 row, rows padded and stacked [S, Pmax] SHARDED over the pipe
+  axis (optimizer state too), so per-device memory is ~1/S of the model —
+  branch s unflattens its own row inside the switch, grads arrive on the
+  owning device via the ppermute-transpose chain (no grad psum), and the
+  elementwise updater acts on the rows directly (bitwise-identical to
+  per-layer updates; guarded: no per-layer lr overrides / grad norm — with
+  those set, params fall back to REPLICATED with a one-time stderr note).
 
 - **Orchestrated** (explicit opt-in / fallback): per-stage ``jax.vjp``
   calls with real per-device param placement — partitions param memory for
@@ -196,6 +199,14 @@ class PipelineParallelTrainingMaster(TrainingMaster):
     def _validate(self, net):
         if net.conf.backprop_type == "truncated_bptt":
             raise ValueError("pipeline master does not support TBPTT")
+        if not hasattr(net.layers[-1], "score"):
+            # every path (compiled, hetero, orchestrated) computes the loss
+            # through the tail layer's score(); fail here with guidance
+            # instead of deep inside a stage function
+            raise ValueError(
+                f"pipeline master needs the net to end in an output layer "
+                f"with a score() (OutputLayer/RnnOutputLayer); got "
+                f"'{net.layers[-1].name}' ({type(net.layers[-1]).__name__})")
         for layer in net.layers:
             if layer.init_state():
                 raise ValueError(
@@ -227,16 +238,25 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                     self._built = True
                     return
             # heterogeneous stacks still compile (switch-per-stage, padded
-            # activation buffer, replicated params — module docstring)
-            from deeplearning4j_tpu.nn.layers.dense import OutputLayer as _O
-
-            if isinstance(net.layers[-1], _O):
-                self._build_compiled_hetero(net)
-                self._built = True
-                return
-            if self.mode == "compiled":
-                raise ValueError(
-                    "mode='compiled' needs the net to end in an OutputLayer")
+            # activation buffer — module docstring).  Params SHARD over the
+            # pipe axis (flat-concat-pad rows, one per stage) whenever the
+            # updater math is exactly elementwise — the same guard the
+            # periodic path uses; otherwise they stay replicated, which is
+            # a per-device MEMORY cost worth flagging once.
+            shard_params = (not lr_overrides
+                            and cfg.gradient_normalization in (None, "none"))
+            if not shard_params and self.mode == "auto":
+                import sys as _sys
+                print(
+                    "pipeline note: auto mode compiled this non-periodic "
+                    "net with REPLICATED params (per-layer lr overrides / "
+                    "gradient normalization prevent the sharded flat "
+                    "layout); per-device memory holds the full model — use "
+                    "mode='orchestrated' for partitioned placement",
+                    file=_sys.stderr)
+            self._build_compiled_hetero(net, shard_params=shard_params)
+            self._built = True
+            return
         self.stages = split_stages(net, self.n_stages)
         self.stage_layers = [[net.layers[i] for i in s] for s in self.stages]
         out_layer = net.layers[-1]
@@ -316,22 +336,102 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         self._compiled_steps = {}  # (xs.shape, ys.shape) -> jitted step
 
     # ------------------------------------- compiled heterogeneous schedule
-    def _build_compiled_hetero(self, net):
+    def _build_compiled_hetero(self, net, shard_params: bool = False):
         """One-XLA-program GPipe for NON-periodic stacks: stage bodies in a
         ``lax.switch`` on the pipe index, boundary activations in a flat
-        padded buffer, params replicated (see module docstring)."""
+        padded buffer.  With ``shard_params`` (the default whenever the
+        updater is exactly elementwise), each stage's param tree is raveled
+        and concatenated into one f32 row, rows padded to the largest stage
+        and stacked [S, Pmax] SHARDED over the pipe axis — per-device param
+        (and optimizer-state) memory is ~1/S of the model, the same
+        partitioning the periodic path gets from stacking, applied to
+        heterogeneous trees via the flat buffer trick the activations
+        already use.  Otherwise params stay replicated (see module
+        docstring)."""
         self.stages = split_stages(net, self.n_stages)
         self.stage_layers = [[net.layers[i] for i in s] for s in self.stages]
         S = len(self.stages)
         self.n_stages = S
         self._mesh = Mesh(np.asarray(self.devices[:S]), ("pipe",))
         self._repl_sharding = NamedSharding(self._mesh, P())
+        self._row_sharding = NamedSharding(self._mesh, P("pipe"))
         self._upd_cfg = net.conf.updater
         self._lr_overrides = {l.name: l.learning_rate for l in net.layers
                               if l.learning_rate is not None}
         self._mode = "compiled"
         self._compiled_kind = "hetero"
+        self._hetero_sharded = shard_params
+        if shard_params:
+            self._flat_specs, self._flat_pmax = self._hetero_flat_spec(net)
         self._compiled_steps = {}
+
+    def _hetero_flat_spec(self, net):
+        """Per-stage flatten layout: (layer, path, shape, dtype, offset,
+        size) per leaf, in deterministic (layer order, sorted path) order;
+        returns (specs, Pmax)."""
+        def leaves(d, prefix=()):
+            out = []
+            for k in sorted(d):
+                v = d[k]
+                if isinstance(v, dict):
+                    out.extend(leaves(v, prefix + (k,)))
+                else:
+                    out.append((prefix + (k,), v))
+            return out
+
+        specs, sizes = [], []
+        for ls in self.stage_layers:
+            spec, off = [], 0
+            for l in ls:
+                for path, a in leaves(net.params.get(l.name, {}) or {}):
+                    n = int(np.prod(a.shape))
+                    spec.append((l.name, path, tuple(a.shape),
+                                 jnp.dtype(a.dtype), off, n))
+                    off += n
+            specs.append(spec)
+            sizes.append(off)
+        return specs, max(max(sizes), 1)
+
+    def _hetero_flatten(self, per_layer, missing_ok: bool = False):
+        """Per-layer tree -> [S, Pmax] f32 rows (host side).  With
+        ``missing_ok`` absent leaves flatten to zeros (fresh optimizer
+        state)."""
+        rows = np.zeros((len(self._flat_specs), self._flat_pmax), np.float32)
+        for s, spec in enumerate(self._flat_specs):
+            for lname, path, shape, dtype, off, n in spec:
+                node = per_layer.get(lname, {})
+                for k in path:
+                    node = node.get(k, {}) if isinstance(node, dict) else {}
+                if isinstance(node, dict):
+                    if not missing_ok:
+                        raise KeyError(f"missing param {lname}/{path}")
+                    continue
+                rows[s, off:off + n] = np.asarray(
+                    node, np.float32).reshape(-1)
+        return jnp.asarray(rows)
+
+    def _hetero_unflatten_host(self, rows) -> Dict[str, Any]:
+        """[S, Pmax] rows -> per-layer tree (host side, original dtypes)."""
+        rows = np.asarray(rows)
+        out: Dict[str, Any] = {}
+        for s, spec in enumerate(self._flat_specs):
+            for lname, path, shape, dtype, off, n in spec:
+                node = out.setdefault(lname, {})
+                for k in path[:-1]:
+                    node = node.setdefault(k, {})
+                node[path[-1]] = jnp.asarray(
+                    rows[s, off:off + n].reshape(shape).astype(dtype))
+        return out
+
+    def _hetero_stage_tree(self, s: int, flat):
+        """Unflatten ONE stage's tree from its local flat row (traced)."""
+        out: Dict[str, Any] = {}
+        for lname, path, shape, dtype, off, n in self._flat_specs[s]:
+            node = out.setdefault(lname, {})
+            for k in path[:-1]:
+                node = node.setdefault(k, {})
+            node[path[-1]] = flat[off:off + n].reshape(shape).astype(dtype)
+        return out
 
     def _make_hetero_step(self, net, x_mb_shape, x_dtype):
         S = len(self.stage_layers)
@@ -365,54 +465,65 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         buf_dtype = jnp.result_type(*[b.dtype for b in bound])
         buf = max(int(np.prod(b.shape)) for b in bound)
 
-        def spmd(tree, xs, ys):
-            idx = lax.axis_index("pipe")
+        def schedule_loss(tree_for, xs, ys, idx):
+            """The GPipe tick scan for ONE device's stage(s).  ``tree_for(s)``
+            is called INSIDE branch s — with sharded params it unflattens
+            the device's own row there, so only the taken branch's stage
+            tree ever materializes (lax.switch executes one branch); the
+            ppermute stays OUTSIDE the switch (collectives must sit at a
+            uniform program point across devices)."""
             perm = [(i, i + 1) for i in range(S - 1)]
 
-            def local_loss(tree):
-                def make_branch(s):
-                    def br(state, t):
-                        if s == 0:
-                            a = xs[jnp.clip(t, 0, M - 1)]
-                        else:
-                            b = bound[s - 1]
-                            n = int(np.prod(b.shape))
-                            a = state[:n].reshape(b.shape).astype(b.dtype)
-                        a = stage_fwd(s, tree, a)
-                        if s == S - 1:
-                            m_out = t - (S - 1)
-                            l = out_layer.score(
-                                tree.get(out_layer.name, {}), a,
-                                ys[jnp.clip(m_out, 0, M - 1)])
-                            return (jnp.zeros((buf,), buf_dtype),
-                                    l.astype(jnp.float32))
-                        flat = a.reshape(-1).astype(buf_dtype)
-                        return (jnp.pad(flat, (0, buf - flat.shape[0])),
-                                jnp.zeros((), jnp.float32))
-                    return br
+            def make_branch(s):
+                def br(state, t):
+                    tree = tree_for(s)
+                    if s == 0:
+                        a = xs[jnp.clip(t, 0, M - 1)]
+                    else:
+                        b = bound[s - 1]
+                        n = int(np.prod(b.shape))
+                        a = state[:n].reshape(b.shape).astype(b.dtype)
+                    a = stage_fwd(s, tree, a)
+                    if s == S - 1:
+                        m_out = t - (S - 1)
+                        l = out_layer.score(
+                            tree.get(out_layer.name, {}), a,
+                            ys[jnp.clip(m_out, 0, M - 1)])
+                        return (jnp.zeros((buf,), buf_dtype),
+                                l.astype(jnp.float32))
+                    flat = a.reshape(-1).astype(buf_dtype)
+                    return (jnp.pad(flat, (0, buf - flat.shape[0])),
+                            jnp.zeros((), jnp.float32))
+                return br
 
-                branches = [make_branch(s) for s in range(S)]
-                state0 = lax.pcast(jnp.zeros((buf,), buf_dtype), ("pipe",),
-                                   to="varying")
-                loss0 = lax.pcast(jnp.zeros(()), ("pipe",), to="varying")
+            branches = [make_branch(s) for s in range(S)]
+            state0 = lax.pcast(jnp.zeros((buf,), buf_dtype), ("pipe",),
+                               to="varying")
+            loss0 = lax.pcast(jnp.zeros(()), ("pipe",), to="varying")
 
-                def tick(carry, t):
-                    state, loss_sum = carry
-                    out, l = lax.switch(idx, branches, state, t)
-                    m_out = t - (S - 1)
-                    loss_sum = loss_sum + jnp.where(
-                        (idx == S - 1) & (m_out >= 0), l, 0.0)
-                    state = lax.ppermute(out, "pipe", perm)
-                    return (state, loss_sum), None
+            def tick(carry, t):
+                state, loss_sum = carry
+                out, l = lax.switch(idx, branches, state, t)
+                m_out = t - (S - 1)
+                loss_sum = loss_sum + jnp.where(
+                    (idx == S - 1) & (m_out >= 0), l, 0.0)
+                state = lax.ppermute(out, "pipe", perm)
+                return (state, loss_sum), None
 
-                (_, loss_sum), _ = lax.scan(
-                    tick, (state0, loss0), jnp.arange(M + S - 1))
-                # LOCAL loss only (nonzero on the last stage); grads are
-                # nonzero only for the executing stage's branch — the psum
-                # below reassembles the full tree without double counting
-                return loss_sum / M
+            (_, loss_sum), _ = lax.scan(
+                tick, (state0, loss0), jnp.arange(M + S - 1))
+            # LOCAL loss only (nonzero on the last stage); grads are
+            # nonzero only for the executing stage's branch
+            return loss_sum / M
 
-            loss, grads = jax.value_and_grad(local_loss)(tree)
+        if self._hetero_sharded:
+            return self._finish_hetero_sharded_step(schedule_loss, cfg, S)
+
+        def spmd(tree, xs, ys):
+            idx = lax.axis_index("pipe")
+            loss, grads = jax.value_and_grad(
+                lambda tr: schedule_loss(lambda s: tr, xs, ys, idx))(tree)
+            # the psum reassembles the full tree without double counting
             return lax.psum(loss, "pipe"), lax.psum(grads, "pipe")
 
         repl = P()
@@ -446,10 +557,74 @@ class PipelineParallelTrainingMaster(TrainingMaster):
 
         return jax.jit(step, donate_argnums=(0, 1))
 
+    def _finish_hetero_sharded_step(self, schedule_loss, cfg, S):
+        """Sharded-param variant: each device owns one [Pmax] f32 row
+        holding its stage's raveled params; branch s unflattens ITS row.
+        Grads w.r.t. the local row arrive via the ppermute-transpose chain
+        with support only on the owning device — no grad psum at all (the
+        dp all-reduce's absence is the point: pipe-axis traffic is
+        activations + their cotangents only).  The elementwise updater then
+        acts directly on the sharded [S, Pmax] rows (one pseudo-layer),
+        bitwise-identical to per-layer updates because sgd/nesterov/adam/
+        etc. are per-element — guarded upstream: no lr overrides, no
+        gradient normalization."""
+        stage_layers = self.stage_layers
+
+        def spmd(flat_rows, xs, ys):
+            idx = lax.axis_index("pipe")
+
+            def local_total(flat):
+                # branch s unflattens MY row as stage s's tree INSIDE the
+                # switch branch — correct on the one device whose idx == s,
+                # never materialized elsewhere
+                loss = schedule_loss(
+                    lambda s: self._hetero_stage_tree(s, flat), xs, ys, idx)
+
+                def make_reg(s):
+                    def rb(flat):
+                        tree = self._hetero_stage_tree(s, flat)
+                        r = jnp.zeros(())
+                        for l in stage_layers[s]:
+                            if l.has_params():
+                                r = r + l.reg_score(tree.get(l.name, {}))
+                        return r
+                    return rb
+
+                return loss + lax.switch(
+                    idx, [make_reg(s) for s in range(S)], flat)
+
+            loss, gflat = jax.value_and_grad(local_total)(flat_rows[0])
+            return lax.psum(loss, "pipe"), gflat[None]
+
+        sharded = shard_map(spmd, mesh=self._mesh,
+                            in_specs=(P("pipe"), P(), P()),
+                            out_specs=(P(), P("pipe")), check_vma=False)
+
+        def step(flat, opt_state, it, xs, ys):
+            loss, gflat = sharded(flat, xs, ys)
+            updates, new_opt = upd.update(
+                cfg, {"_pipe": {"w": gflat}}, opt_state, it, {},
+                params={"_pipe": {"w": flat}})
+            return flat - updates["_pipe"]["w"], new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
     def _execute_hetero(self, net, iterator):
         M = self.n_microbatches
-        tree = jax.device_put(net.params, self._repl_sharding)
-        opt_state = jax.device_put(net.updater_state, self._repl_sharding)
+        if self._hetero_sharded:
+            # flat f32 rows, one per stage, device s owns row s — params
+            # AND optimizer state partitioned ~1/S per device
+            tree = jax.device_put(self._hetero_flatten(net.params),
+                                  self._row_sharding)
+            opt_state = {
+                k: {"_pipe": {"w": jax.device_put(
+                    self._hetero_flatten(per_layer, missing_ok=True),
+                    self._row_sharding)}}
+                for k, per_layer in net.updater_state.items()}
+        else:
+            tree = jax.device_put(net.params, self._repl_sharding)
+            opt_state = jax.device_put(net.updater_state,
+                                       self._repl_sharding)
         for ds in iterator:
             if ds.features_mask is not None or ds.labels_mask is not None:
                 raise ValueError(
@@ -471,8 +646,14 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             net.iteration += 1
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration)
-        net.params = tree
-        net.updater_state = opt_state
+        if self._hetero_sharded:
+            net.params.update(self._hetero_unflatten_host(tree))
+            for k in net.updater_state:
+                net.updater_state[k].update(self._hetero_unflatten_host(
+                    opt_state[k]["_pipe"]["w"]))
+        else:
+            net.params = tree
+            net.updater_state = opt_state
 
     # --- facade <-> pipeline param tree conversion (keys: pfx/ blk/ sfx/)
     def _stack_tree(self, per_layer: Dict[str, Any]) -> Dict[str, Any]:
