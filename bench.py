@@ -1,10 +1,12 @@
 """Benchmark entry — ResNet-50 images/sec/chip (headline, with MFU), plus
 LeNet-MNIST step time and GravesLSTM char-LM throughput.
 
-Prints ONE JSON line.  Top-level fields follow the driver schema
-(metric/value/unit/vs_baseline) for the headline metric; the ``all`` field
-carries every metric with FLOPs (XLA cost analysis of the compiled train
-step), MFU vs the chip's peak, and data provenance (``real`` | ``synthetic``).
+Prints ONE compact JSON line (last on stdout, <= ~1500 chars — the driver
+tail-captures ~2 KB and parses the final line) with the driver schema
+(metric/value/unit/vs_baseline) for the headline metric plus a per-metric
+value summary.  The FULL multi-metric payload — FLOPs (XLA cost analysis of
+the compiled train step), MFU vs the chip's peak, spreads, variants, data
+provenance (``real`` | ``synthetic``) — is written to ``bench_full.json``.
 
 Baselines: the reference (DL4J 0.4 on CPU BLAS) publishes no numbers
 (BASELINE.md), so measured torch-CPU runs of the same configs stand in —
@@ -20,6 +22,7 @@ import json
 import os
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -257,6 +260,34 @@ def bench_lenet(platform, baselines):
     warmup, iters = (5, 100) if platform == "tpu" else (2, 10)
     peak = _peak_flops(jax.devices()[0])
     dt, timing, spread = _checked_time(one, warmup, iters, _sync, flops, peak)
+
+    # Amortized variant: K updates per dispatch via the lax.scan window
+    # (models/sequential.py _make_scanned_step) — the prescribed fix for the
+    # ~1 ms host/tunnel dispatch floor that dominates LeNet-class models
+    # (PROFILE.md).  Measured beside the per-step path so the floor AND the
+    # fix are both on record.
+    K = 32
+    scanned = net._make_scanned_step()
+    xs = jnp.broadcast_to(xj, (K,) + xj.shape)
+    ys = jnp.broadcast_to(yj, (K,) + yj.shape)
+    # seed from the per-step loop's LIVE state: net.params was donated away
+    # by the first per-step call above
+    sstate = [state[0], state[1], state[2]]
+    _, scompiled = _compile_step(
+        scanned, sstate[0], sstate[1], sstate[2], jnp.zeros(()), xs, ys,
+        jnp.stack([net._keys.next() for _ in range(K)]))
+
+    def one_window():
+        sstate[0], sstate[1], sstate[2], losses = scompiled(
+            sstate[0], sstate[1], sstate[2], jnp.zeros(()), xs, ys,
+            jnp.stack([net._keys.next() for _ in range(K)]))
+        return losses
+
+    w_warm, w_iters = (2, 10) if platform == "tpu" else (1, 2)
+    dtw, _, sspread = _checked_time(one_window, w_warm, w_iters, _sync,
+                                    flops * K, peak)
+    amortized_ms = dtw / K * 1e3
+
     base = baselines["lenet_step_ms"]
     return {
         "metric": "LeNet-MNIST train step time (batch 128)",
@@ -267,6 +298,16 @@ def bench_lenet(platform, baselines):
         "dtype": "float32",
         "flops_per_step": flops,
         "imgs_per_sec": round(batch / dt, 1),
+        "scanned_k": K,
+        "scanned_step_ms": round(amortized_ms, 3),
+        "scanned_speedup": round(dt * 1e3 / amortized_ms, 2),
+        # XLA:CPU runs convolutions with loop-carried weights ~9x slower
+        # inside lax.scan (no prepacked fast path; measured: dense-only
+        # nets scan 1.2x FASTER) — the scan exists for the TPU dispatch
+        # floor, so judge the speedup only from a platform:"tpu" row
+        "scanned_note": (None if platform == "tpu" else
+                         "cpu conv-in-scan artifact; see PROFILE.md"),
+        "scanned_spread": sspread,
         "timing": timing,
         "spread": spread,
     }
@@ -489,8 +530,11 @@ def bench_decode(platform, peak):
         steps, cache = 256, 2048
         warmup, iters = (2, 8)
     else:
-        batch, d_model, heads, layers = 2, 32, 2, 1
-        steps, cache = 8, 32
+        # sized so KV streaming DOMINATES even on CPU: ~34 MB MHA cache
+        # (fp32) vs ~1 MB of weights — a d32/L1 toy config has a ~0 MB
+        # cache and cannot distinguish MHA from GQA even directionally
+        batch, d_model, heads, layers = 4, 256, 4, 4
+        steps, cache = 32, 1024
         warmup, iters = (1, 2)
     vocab = 128
     window = cache // 8
@@ -508,7 +552,7 @@ def bench_decode(platform, peak):
         carries = seed_stream_caches(
             ((l.name, l) for l in net.layers), {}, batch,
             net.conf.compute_dtype)
-        check_cache_capacity(carries, 1 + steps, pos=0)
+        check_cache_capacity(carries, steps, pos=0)  # occupancy: 1 + steps - 1
         fn = jax.jit(build_decode_fn(net, steps, temperature=1.0))
         prompt = jnp.zeros((batch, 1), jnp.int32)
         key = jax.random.PRNGKey(0)
@@ -655,7 +699,7 @@ def main():
         raise RuntimeError("; ".join(errors) or "no metric ran")
 
     head = metrics[0]
-    result = {
+    full = {
         "metric": head["metric"],
         "value": head["value"],
         "unit": head["unit"],
@@ -669,8 +713,54 @@ def main():
         "all": metrics,
     }
     if errors:
-        result["errors"] = errors
-    print(json.dumps(result))
+        full["errors"] = errors
+    print(emit_result(full))
+
+
+def emit_result(full: dict, out_dir: Optional[str] = None) -> str:
+    """Write the full payload to ``bench_full.json`` and return the compact
+    headline line.  The driver tail-captures ~2 KB of stdout and parses the
+    LAST line, so the multi-metric payload (which outgrew that window in
+    round 4 — BENCH_r04.json ``"parsed": null``) goes to the file and the
+    final stdout line is a headline guaranteed to fit — and guaranteed to
+    PARSE: the shrink path drops whole fields, never slices the serialized
+    JSON (a mid-string cut would recreate the round-4 failure)."""
+    path = os.path.join(out_dir or os.path.dirname(os.path.abspath(__file__)),
+                        "bench_full.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(full, f, indent=1)
+    except OSError as e:
+        # a read-only checkout must not cost the headline line
+        full = dict(full, full_write_error=str(e)[:120])
+    compact = {
+        "metric": full["metric"],
+        "value": full["value"],
+        "unit": full["unit"],
+        "vs_baseline": full["vs_baseline"],
+        "mfu": full.get("mfu"),
+        "platform": full["platform"],
+        "device_kind": full["device_kind"],
+        "summary": {m["metric"].split(" (")[0]: m["value"]
+                    for m in full["all"]},
+        "full": "bench_full.json",
+    }
+    if full.get("full_write_error"):
+        compact["full_write_error"] = full["full_write_error"]
+    if full.get("errors"):
+        compact["errors"] = [e[:120] for e in full["errors"][:2]]
+    # shrink to the capture window by dropping whole fields (never slicing
+    # the serialized string): summary first, then errors, then the metric
+    # name — each step keeps the line valid JSON
+    for drop in ("summary", "errors", "metric"):
+        line = json.dumps(compact)
+        if len(line) <= 1500:
+            return line
+        if drop == "metric":
+            compact["metric"] = compact["metric"][:100]
+        else:
+            compact.pop(drop, None)
+    return json.dumps(compact)
 
 
 def _cpu_fallback() -> int:

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Standing TPU tunnel probe loop (VERDICT r4 task #2).
+# Probes every 10 min; logs each attempt to profiles/tunnel_probe_r05.log.
+# On success, touches /tmp/TPU_UP and exits so the builder can run the
+# on-chip queue (pytest -m tpu, bench.py, profile_tpu.py).
+LOG=/root/repo/profiles/tunnel_probe_r05.log
+rm -f /tmp/TPU_UP
+while true; do
+  TS=$(date -u +%H:%M:%SZ)
+  if timeout 110 python -c "import jax, jax.numpy as jnp; jax.device_get(jnp.ones((8,8)).sum()); print(jax.devices()[0].platform)" 2>/dev/null | grep -qi tpu; then
+    echo "$TS UP" >> "$LOG"
+    touch /tmp/TPU_UP
+    exit 0
+  else
+    echo "$TS WEDGED" >> "$LOG"
+  fi
+  sleep 600
+done
