@@ -43,6 +43,19 @@ def _parse_batch_file(path: Path) -> Tuple[np.ndarray, np.ndarray]:
     return images, labels
 
 
+def write_cifar_batch(path, images_u8: np.ndarray, labels: np.ndarray) -> None:
+    """Format inverse of ``_parse_batch_file`` — writes the CIFAR-10 binary
+    batch record layout (1 label byte + 3072 CHW RGB bytes per record) so
+    the REAL parse branch can be exercised hermetically (no egress; the
+    same ``write_idx`` trick tests/test_mnist_idx.py uses)."""
+    images_u8 = np.asarray(images_u8, np.uint8).reshape(len(images_u8), 3072)
+    labels = np.asarray(labels, np.uint8).reshape(-1, 1)
+    if len(images_u8) != len(labels):
+        raise ValueError(f"{len(images_u8)} images vs {len(labels)} labels")
+    Path(path).write_bytes(
+        np.concatenate([labels, images_u8], axis=1).tobytes())
+
+
 def _find_dir(data_dir: Optional[str]) -> Path:
     return Path(data_dir or os.environ.get(
         "DL4J_TPU_CIFAR_DIR", Path.home() / ".deeplearning4j_tpu" / "cifar10"))

@@ -4,9 +4,12 @@ Reference: ``deeplearning4j-core/.../datasets/fetchers/LFWDataFetcher.java``
 + ``iterator/impl/LFWDataSetIterator.java`` (downloads the LFW archive, one
 directory per person, images resized to a fixed shape, person index as the
 class label).  No egress here, so:
- 1. load ``faces.npy``/``labels.npy`` (or per-class ``<name>.npy`` stacks)
-    from ``DL4J_TPU_LFW_DIR`` when present;
- 2. otherwise generate deterministic synthetic face-shaped images
+ 1. parse the reference's on-disk layout — one DIRECTORY per person under
+    ``DL4J_TPU_LFW_DIR``, containing P5 PGM images (parsed natively, no
+    image library), sorted person-directory index as the class label,
+    nearest-neighbour resize to ``SIDE`` x ``SIDE`` — when present;
+ 2. else load pre-extracted ``faces.npy``/``labels.npy`` arrays;
+ 3. otherwise generate deterministic synthetic face-shaped images
     (elliptical head + class-dependent feature geometry), flagged
     ``is_synthetic``.
 """
@@ -26,6 +29,66 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
 
 SIDE = 40
+
+
+def read_pgm(path) -> np.ndarray:
+    """Parse a binary (P5) PGM image to a uint8 [H, W] array — native
+    parse of a real image format, no image library (the reference decodes
+    its jpgs through ImageLoader; PGM keeps the branch hermetic)."""
+    raw = Path(path).read_bytes()
+    fields, pos = [], 0
+    while len(fields) < 4:  # magic, width, height, maxval
+        if pos >= len(raw):
+            raise ValueError(f"{path}: truncated PGM header")
+        if raw[pos:pos + 1] == b"#":          # comment to end of line
+            pos = raw.index(b"\n", pos) + 1
+            continue
+        if raw[pos:pos + 1].isspace():
+            pos += 1
+            continue
+        end = pos
+        while end < len(raw) and not raw[end:end + 1].isspace():
+            end += 1
+        fields.append(raw[pos:end])
+        pos = end
+    if fields[0] != b"P5":
+        raise ValueError(f"{path}: not a binary P5 PGM (magic {fields[0]!r})")
+    w, h, maxval = int(fields[1]), int(fields[2]), int(fields[3])
+    if maxval > 255:
+        raise ValueError(f"{path}: 16-bit PGM unsupported (maxval {maxval})")
+    pos += 1  # single whitespace after maxval
+    img = np.frombuffer(raw, np.uint8, count=w * h, offset=pos)
+    return img.reshape(h, w)
+
+
+def write_pgm(path, img_u8: np.ndarray) -> None:
+    """Format inverse of ``read_pgm`` (binary P5) for hermetic fixtures."""
+    img_u8 = np.asarray(img_u8, np.uint8)
+    h, w = img_u8.shape
+    Path(path).write_bytes(b"P5\n%d %d\n255\n" % (w, h) + img_u8.tobytes())
+
+
+def _resize_nearest(img: np.ndarray, side: int) -> np.ndarray:
+    h, w = img.shape
+    ys = (np.arange(side) * h // side).clip(0, h - 1)
+    xs = (np.arange(side) * w // side).clip(0, w - 1)
+    return img[np.ix_(ys, xs)]
+
+
+def _load_person_dirs(root: Path) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """The reference's archive layout: ``root/<person>/*.pgm``, label =
+    sorted person index (LFWDataFetcher labels by directory)."""
+    people = sorted(p for p in root.iterdir() if p.is_dir()
+                    and any(p.glob("*.pgm")))
+    if not people:
+        return None
+    feats, labels = [], []
+    for idx, person in enumerate(people):
+        for img_path in sorted(person.glob("*.pgm")):
+            img = _resize_nearest(read_pgm(img_path), SIDE)
+            feats.append(img.astype(np.float32).reshape(-1) / 255.0)
+            labels.append(idx)
+    return np.stack(feats), np.asarray(labels, np.int64)
 
 
 def _synthetic_faces(n: int, num_classes: int, seed: int
@@ -59,7 +122,13 @@ class LFWDataFetcher:
         root = Path(data_dir or os.environ.get(
             "DL4J_TPU_LFW_DIR", Path.home() / ".deeplearning4j_tpu" / "lfw"))
         feats = labels = None
-        if (root / "faces.npy").exists() and (root / "labels.npy").exists():
+        if root.is_dir():
+            loaded = _load_person_dirs(root)
+            if loaded is not None:
+                feats, labels = loaded
+                num_classes = int(labels.max()) + 1
+        if feats is None and (root / "faces.npy").exists() \
+                and (root / "labels.npy").exists():
             feats = np.load(root / "faces.npy").astype(np.float32)
             labels = np.load(root / "labels.npy").astype(np.int64)
             feats = feats.reshape(len(feats), -1)

@@ -72,6 +72,13 @@ for _w in ("and or but nor so yet".split()):
 for _w in ("is am are was were be been being have has had do does did "
            "will would can could shall should may might must".split()):
     _LEXICON[_w] = "VERB"
+# common irregular past/base forms the suffix rules can't catch — only
+# forms that are UNAMBIGUOUSLY verbal (homographs like left/saw/found/
+# read/made/felt would mis-tag noun/adjective uses and fragment NPs)
+for _w in ("ran run sat went goes take got came come said say told tell "
+           "gave give knew know thought think kept held heard met brought "
+           "began wrote".split()):
+    _LEXICON[_w] = "VERB"
 for _w in ("not never also very too quite really".split()):
     _LEXICON[_w] = "ADV"
 

@@ -226,10 +226,15 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             raise ValueError("mode='compiled' needs n_stages >= 2 "
                              f"(got {self.n_stages})")
         if self.mode != "orchestrated" and self.n_stages > 1:
+            # param sharding (periodic stacked OR hetero flat rows) is only
+            # exact when the updater math is purely per-element: no
+            # per-layer lr overrides, no per-layer grad-norm reductions
+            elementwise_updater = (
+                not lr_overrides
+                and cfg.gradient_normalization in (None, "none"))
             # best path: periodic run -> stacked params SHARDED stage-per-
             # device (param memory partitioned)
-            if (not lr_overrides
-                    and cfg.gradient_normalization in (None, "none")):
+            if elementwise_updater:
                 run = find_periodic_run([_layer_sig(l) for l in net.layers],
                                         self.n_stages)
                 if (run is not None
@@ -239,12 +244,10 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                     return
             # heterogeneous stacks still compile (switch-per-stage, padded
             # activation buffer — module docstring).  Params SHARD over the
-            # pipe axis (flat-concat-pad rows, one per stage) whenever the
-            # updater math is exactly elementwise — the same guard the
-            # periodic path uses; otherwise they stay replicated, which is
-            # a per-device MEMORY cost worth flagging once.
-            shard_params = (not lr_overrides
-                            and cfg.gradient_normalization in (None, "none"))
+            # pipe axis (flat-concat-pad rows, one per stage) under the
+            # same elementwise guard; otherwise they stay replicated,
+            # which is a per-device MEMORY cost worth flagging once.
+            shard_params = elementwise_updater
             if not shard_params and self.mode == "auto":
                 import sys as _sys
                 print(

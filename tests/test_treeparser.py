@@ -1,0 +1,125 @@
+"""Constituency tree parser + vectorizer (≙ the reference's UIMA
+treeparser package: TreeParser.java:60, TreeVectorizer.java,
+HeadWordFinder.java, BinarizeTreeTransformer.java, CollapseUnaries.java)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.treeparser import (
+    BinarizeTreeTransformer, CollapseUnaries, HeadWordFinder, Tree,
+    TreeParser, TreeVectorizer,
+)
+
+
+def test_parser_sentence_trees_cover_all_tokens():
+    parser = TreeParser()
+    trees = parser.get_trees("The quick dog chased a red ball. It was fun.")
+    assert len(trees) == 2
+    assert trees[0].label == "S"
+    assert [t.lower() for t in trees[0].tokens()] == [
+        "the", "quick", "dog", "chased", "a", "red", "ball."]
+    # every token sits under a preterminal POS node
+    for leaf in trees[0].leaves():
+        assert leaf.is_leaf() and leaf.token
+
+
+def test_parser_phrase_structure():
+    parser = TreeParser()
+    (tree,) = parser.get_trees("The quick dog ran in the park")
+    labels = [c.label for c in tree.children]
+    assert labels[0] == "NP"        # the quick dog
+    assert "VP" in labels           # ran
+    assert "PP" in labels           # in the park
+    pp = tree.children[labels.index("PP")]
+    assert pp.children[0].label == "ADP"
+    assert pp.children[1].label == "NP"
+
+
+def test_empty_text_gives_no_trees():
+    assert TreeParser().get_trees("") == []
+
+
+def test_head_word_finder():
+    parser = TreeParser()
+    (tree,) = parser.get_trees("The quick dog chased the ball")
+    finder = HeadWordFinder()
+    # S's head comes from the VP (Collins S -> VP rule)
+    assert finder.find_head_word(tree) == "chased"
+    np_tree = tree.children[0]
+    assert np_tree.label == "NP"
+    # NP head: rightmost noun
+    assert finder.find_head_word(np_tree) == "dog"
+
+
+def test_binarize_caps_fanout_at_two():
+    parser = TreeParser()
+    (tree,) = parser.get_trees(
+        "The dog ran in the park with a ball and a stick")
+
+    def max_fanout(t):
+        if t.is_leaf():
+            return 0
+        return max([len(t.children)] + [max_fanout(c) for c in t.children])
+
+    assert max_fanout(tree) > 2  # the raw S is flat
+    binarized = BinarizeTreeTransformer().transform(tree)
+    assert max_fanout(binarized) <= 2
+    # binarization preserves the yield exactly
+    assert binarized.tokens() == tree.tokens()
+    # intermediate nodes carry the @-marked parent label
+    assert any(c.label == "@S" for c in binarized.children)
+
+
+def test_collapse_unaries():
+    # X -> NP -> (...) unary chain collapses to one node keeping top label
+    inner = Tree("NP", [Tree("NOUN", [Tree("dog", token="dog")])])
+    outer = Tree("X", [inner])
+    collapsed = CollapseUnaries().transform(outer)
+    assert collapsed.label == "X"
+    assert collapsed.children[0].label == "NOUN"  # preterminal survives
+    assert collapsed.tokens() == ["dog"]
+
+
+def test_vectorizer_pipeline_binarized_and_labeled():
+    vec = TreeVectorizer()
+    trees = vec.get_trees_with_labels(
+        "The movie was great. The food was terrible.")
+    assert len(trees) == 2
+    assert trees[0].gold_label == "positive"
+    assert trees[1].gold_label == "negative"
+    # labels propagate to every node (RNTN per-node target)
+    for node in trees[0].children:
+        assert node.gold_label == "positive"
+
+
+def test_vectorizer_explicit_labels():
+    vec = TreeVectorizer()
+    trees = vec.get_trees_with_labels("A dog ran. A cat sat.", ["x", "y"])
+    assert [t.gold_label for t in trees] == ["x", "y"]
+
+
+class _ToyVectors:
+    def __init__(self, words, dim=4):
+        self.v = {w: np.full(dim, i + 1.0, np.float32)
+                  for i, w in enumerate(words)}
+
+    def get_word_vector(self, word):
+        return self.v.get(word)
+
+
+def test_vectorize_attaches_leaf_vectors_with_oov_zeros():
+    vec = TreeVectorizer()
+    wv = _ToyVectors(["the", "dog", "ran"])
+    (tree,) = vec.vectorize("The dog ran quickly", wv)
+    leaves = tree.leaves()
+    by_tok = {l.token.lower().strip("."): l.vector for l in leaves}
+    assert by_tok["dog"].tolist() == [2.0] * 4
+    assert by_tok["quickly"].tolist() == [0.0] * 4  # OOV -> zeros, same dim
+    assert all(l.vector is not None and l.vector.shape == (4,)
+               for l in leaves)
+
+
+def test_tree_repr_is_penn_style():
+    (tree,) = TreeParser().get_trees("The dog ran")
+    s = repr(tree)
+    assert s.startswith("(S (NP (DET ")
+    assert "(VP (VERB " in s
