@@ -50,10 +50,14 @@ def _resolve_encoding(net, prompt_ids, one_hot: Optional[bool],
                       vocab_size: Optional[int]):
     """Shared preamble for the host sampling loop and on-device generate:
     validate the prompt and resolve the input encoding.  Auto-detection
-    works for sequential nets only (a ComputationGraph also exposes
-    ``.layers``, but in topological order — the first entry need not be
-    the input layer, so auto-detect would silently guess wrong; CG callers
-    must pass ``one_hot=`` explicitly)."""
+    covers sequential nets (first layer embedding or not) and
+    SINGLE-INPUT ComputationGraphs (the one input either feeds an
+    EmbeddingLayer or it doesn't — ``net._id_consumer``); multi-input
+    graphs are ambiguous, so those callers must pass ``one_hot=``
+    explicitly.  For one-hot CG inputs the vocab width comes from the
+    INPUT-side consumer's ``n_in`` (the layer the vector actually feeds),
+    never the output head's ``n_out`` — the two differ in
+    asymmetric-vocab graphs."""
     from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
     from deeplearning4j_tpu.nn.layers.dense import EmbeddingLayer
 
@@ -61,18 +65,33 @@ def _resolve_encoding(net, prompt_ids, one_hot: Optional[bool],
     if prompt_ids.ndim != 2:
         raise ValueError(f"prompt_ids must be [B, T], got {prompt_ids.shape}")
     sequential = isinstance(net, MultiLayerNetwork)
+    single_in = sequential or len(net.conf.inputs) == 1
     if one_hot is None:
-        if not sequential:
+        if sequential:
+            one_hot = not (net.layers
+                           and isinstance(net.layers[0], EmbeddingLayer))
+        elif single_in:
+            one_hot = net._id_consumer(net.conf.inputs[0]) is None
+        else:
             raise ValueError(
-                "one_hot auto-detection needs a MultiLayerNetwork; pass "
-                "one_hot= explicitly for a ComputationGraph")
-        one_hot = not (net.layers
-                       and isinstance(net.layers[0], EmbeddingLayer))
+                "one_hot auto-detection needs a single-input net; pass "
+                "one_hot= explicitly for a multi-input ComputationGraph")
     if one_hot and vocab_size is None:
-        if not sequential:
+        if sequential:
+            vocab_size = net.layers[-1].n_out
+        elif single_in:
+            in_name = net.conf.inputs[0]
+            consumer = next((net.nodes[n] for n in net.topo
+                             if in_name in net.nodes[n].inputs), None)
+            layer = getattr(consumer, "layer", None)
+            if layer is None or getattr(layer, "n_in", None) is None:
+                raise ValueError(
+                    "cannot infer the one-hot width: the graph input "
+                    f"'{in_name}' feeds a vertex; pass vocab_size=")
+            vocab_size = layer.n_in
+        else:
             raise ValueError("pass vocab_size= explicitly for a "
-                             "ComputationGraph with one_hot inputs")
-        vocab_size = net.layers[-1].n_out
+                             "multi-input ComputationGraph")
     return prompt_ids, one_hot, vocab_size
 
 
