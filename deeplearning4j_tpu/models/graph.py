@@ -443,32 +443,130 @@ class ComputationGraph(LazyScoreMixin):
         return {self.conf.outputs[0]: labels}
 
     # ---------------------------------------------------------- train step
+    def _step_core(self):
+        """The raw (un-jitted) SGD step shared by the per-batch train step
+        and the scanned multi-step window (mirrors
+        ``MultiLayerNetwork._step_core``)."""
+        from deeplearning4j_tpu.optimize import updaters as upd
+
+        cfg = self.conf.updater
+        lr_overrides = {
+            n.name: n.layer.learning_rate
+            for n in self.conf.nodes
+            if n.layer is not None and n.layer.learning_rate is not None
+        }
+
+        def step(params, upd_state, net_state, iteration, inputs, labels,
+                 rng, fmask, lmask, carries):
+            (loss, (new_ns, new_carries)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True
+            )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
+            grads = {k: v for k, v in grads.items() if v}
+            updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                         lr_overrides, params=params)
+            new_params = dict(params)
+            for lname, u in updates.items():
+                new_params[lname] = upd.apply_updates(params[lname], u)
+            return new_params, new_us, new_ns, loss, new_carries
+
+        return step
+
     def _get_train_step(self):
         if "train_step" not in self._jit_cache:
-            from deeplearning4j_tpu.optimize import updaters as upd
-
-            cfg = self.conf.updater
-            lr_overrides = {
-                n.name: n.layer.learning_rate
-                for n in self.conf.nodes
-                if n.layer is not None and n.layer.learning_rate is not None
-            }
-
-            def step(params, upd_state, net_state, iteration, inputs, labels,
-                     rng, fmask, lmask, carries):
-                (loss, (new_ns, new_carries)), grads = jax.value_and_grad(
-                    self._loss_fn, has_aux=True
-                )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
-                grads = {k: v for k, v in grads.items() if v}
-                updates, new_us = upd.update(cfg, grads, upd_state, iteration,
-                                             lr_overrides, params=params)
-                new_params = dict(params)
-                for lname, u in updates.items():
-                    new_params[lname] = upd.apply_updates(params[lname], u)
-                return new_params, new_us, new_ns, loss, new_carries
-
-            self._jit_cache["train_step"] = jax.jit(step, donate_argnums=(0, 1, 2))
+            self._jit_cache["train_step"] = jax.jit(
+                self._step_core(), donate_argnums=(0, 1, 2))
         return self._jit_cache["train_step"]
+
+    def _make_scanned_step(self):
+        """K weight updates in ONE dispatch — ``lax.scan`` over the step
+        core, amortizing the ~1 ms host/tunnel dispatch floor to 1/K for
+        small graphs (same design as
+        ``MultiLayerNetwork._make_scanned_step``; PROFILE.md)."""
+        core = self._step_core()
+
+        def multi(params, upd_state, net_state, it0, xs, ys, rngs):
+            def body(carry, inp):
+                params, upd_state, net_state, it = carry
+                x, y, rng = inp
+                params, upd_state, net_state, loss, _ = core(
+                    params, upd_state, net_state, it, x, y, rng,
+                    None, None, None)
+                return (params, upd_state, net_state, it + 1.0), loss
+
+            (params, upd_state, net_state, _), losses = jax.lax.scan(
+                body, (params, upd_state, net_state, it0), (xs, ys, rngs))
+            return params, upd_state, net_state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def fit_scanned(self, batches, scan_steps: int, epochs: int = 1):
+        """Amortized training: consecutive same-shape batches stacked
+        ``scan_steps`` at a time into one scanned XLA program — same
+        per-batch updates and RNG stream as ``fit`` over the same batches
+        (the CG SGD path runs each batch once, so no num_iterations
+        divergence is possible); listeners fire once per window with
+        ``score_value`` the window's last loss; a short tail (or a shape
+        change) runs the regular per-batch step.  SGD only; no masks or
+        TBPTT."""
+        if scan_steps < 1:
+            raise ValueError(f"scan_steps={scan_steps} must be >= 1")
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            raise ValueError("fit_scanned requires SGD optimization")
+        if self.conf.backprop_type == "truncated_bptt":
+            raise ValueError("fit_scanned does not support TBPTT")
+        scanned = self._jit_cache.setdefault(
+            "scanned_step", self._make_scanned_step())
+        for _ in range(epochs):
+            window: list = []
+            wshape = None
+            for batch in batches:
+                if hasattr(batch, "features_masks"):  # MultiDataSet
+                    x, y, fm, lm = self._unpack_multi(batch)
+                elif hasattr(batch, "features"):
+                    x, y, fm, lm = (batch.features, batch.labels,
+                                    batch.features_mask, batch.labels_mask)
+                else:
+                    x, y = batch[0], batch[1]
+                    fm = batch[2] if len(batch) > 2 else None
+                    lm = batch[3] if len(batch) > 3 else None
+                if fm is not None or lm is not None:
+                    raise ValueError("fit_scanned does not support masks")
+                x = {k: np.asarray(v)
+                     for k, v in self._as_input_dict(x).items()}
+                y = {k: np.asarray(v)
+                     for k, v in self._as_label_dict(y).items()}
+                shape = ({k: v.shape for k, v in x.items()},
+                         {k: v.shape for k, v in y.items()})
+                if window and shape != wshape:
+                    self._flush_window(window, scanned, scan_steps)
+                    window = []
+                wshape = shape
+                window.append((x, y))
+                if len(window) == scan_steps:
+                    self._flush_window(window, scanned, scan_steps)
+                    window = []
+            if window:
+                self._flush_window(window, scanned, scan_steps)
+        return self
+
+    def _flush_window(self, window, scanned, scan_steps):
+        if len(window) == scan_steps:
+            xs = {k: jnp.asarray(np.stack([b[0][k] for b in window]))
+                  for k in window[0][0]}
+            ys = {k: jnp.asarray(np.stack([b[1][k] for b in window]))
+                  for k in window[0][1]}
+            rngs = jnp.stack([self._keys.next() for _ in window])
+            it0 = jnp.asarray(self.iteration, jnp.float32)
+            (self.params, self.updater_state, self.net_state,
+             losses) = scanned(self.params, self.updater_state,
+                               self.net_state, it0, xs, ys, rngs)
+            self.score_value = losses[-1]
+            self.iteration += len(window)
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration)
+        else:  # short tail: regular per-batch step keeps semantics exact
+            for x, y in window:
+                self._one_step(x, y, None, None, carries=None)
 
     def fit(self, data, labels=None, *, fmask=None, lmask=None):
         """fit(inputs, labels) or fit(iterable of DataSet / MultiDataSet /

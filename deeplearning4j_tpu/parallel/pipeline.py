@@ -41,6 +41,13 @@ Two execution paths:
   pullbacks, the activation-memory win; the bubble fraction is the same
   (S-1)/(M+S-1) as GPipe for non-interleaved stages).
 
+Activation memory on the compiled paths: pass ``remat=True`` to
+``jax.checkpoint`` each schedule tick — in one compiled program reverse-mode
+AD stashes every tick's residuals regardless of schedule order (so a
+compiled "1F1B" would buy nothing over GPipe); rematerializing the tick
+body is the XLA-native equivalent of 1F1B's fewer-live-pullbacks win,
+trading ~1 extra forward for O(1) residuals per tick.
+
 Scope (all paths): sequential stateless nets (no BatchNorm running
 stats, no masks, no TBPTT, no dropout).  Compose with DP/TP via those
 masters; this one owns the pipe axis.
@@ -171,12 +178,19 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                  n_microbatches: int = 4,
                  devices: Optional[Sequence] = None,
                  schedule: str = "gpipe",
-                 mode: str = "auto"):
+                 mode: str = "auto",
+                 remat: bool = False):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"schedule={schedule!r}: use 'gpipe' or '1f1b'")
         if mode not in ("auto", "compiled", "orchestrated"):
             raise ValueError(
                 f"mode={mode!r}: use 'auto', 'compiled' or 'orchestrated'")
+        if remat and mode == "orchestrated":
+            raise ValueError(
+                "remat applies only to the compiled schedules (it "
+                "jax.checkpoint's the compiled tick); the orchestrated "
+                "path holds per-microbatch pullbacks instead — use "
+                "schedule='1f1b' there for the activation-memory win")
         self.devices = list(devices if devices is not None else jax.devices())
         self.n_stages = n_stages or len(self.devices)
         if self.n_stages > len(self.devices):
@@ -185,6 +199,14 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         self.n_microbatches = n_microbatches
         self.schedule = schedule
         self.mode = mode
+        # remat: jax.checkpoint each schedule tick in the COMPILED paths —
+        # the XLA-native counterpart of 1F1B's activation-memory win.  In
+        # one compiled program reverse-mode AD stashes every tick's
+        # residuals (all M + S - 1 of them) regardless of schedule order,
+        # so reordering backwards 1F1B-style buys nothing; what shrinks
+        # live memory is rematerializing the tick body on the backward
+        # pass, trading ~1 extra forward for O(1) residuals per tick.
+        self.remat = remat
         self._built = False
 
     def bubble_fraction(self) -> float:
@@ -260,6 +282,11 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             self._build_compiled_hetero(net, shard_params=shard_params)
             self._built = True
             return
+        if self.remat:  # reachable only via n_stages == 1 (auto/compiled)
+            import sys as _sys
+            print("pipeline note: remat=True has no effect on the "
+                  "orchestrated path (single-stage resolution); it applies "
+                  "to the compiled schedules only", file=_sys.stderr)
         self.stages = split_stages(net, self.n_stages)
         self.stage_layers = [[net.layers[i] for i in s] for s in self.stages]
         out_layer = net.layers[-1]
@@ -504,9 +531,15 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                                to="varying")
             loss0 = lax.pcast(jnp.zeros(()), ("pipe",), to="varying")
 
+            def run_tick(state, t):
+                return lax.switch(idx, branches, state, t)
+
+            if self.remat:  # O(1) residuals per tick; ppermute stays out
+                run_tick = jax.checkpoint(run_tick)
+
             def tick(carry, t):
                 state, loss_sum = carry
-                out, l = lax.switch(idx, branches, state, t)
+                out, l = run_tick(state, t)
                 m_out = t - (S - 1)
                 loss_sum = loss_sum + jnp.where(
                     (idx == S - 1) & (m_out >= 0), l, 0.0)
@@ -728,14 +761,22 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                 state0 = jnp.zeros(probe.shape, probe.dtype)
                 state0 = lax.pcast(state0, ("pipe",), to="varying")
 
-                def tick(carry, t):
-                    state, loss_sum = carry
+                def run_tick(state, t):
                     a0 = prefix_fwd(pfx_p, xs[jnp.clip(t, 0, M - 1)])
                     inp = jnp.where(idx == 0, a0, state)
                     outv = stage_fwd(blk_local, inp)
                     m_out = t - (S - 1)
                     l = suffix_loss(sfx_p, outv,
                                     ys[jnp.clip(m_out, 0, M - 1)])
+                    return outv, l
+
+                if self.remat:  # O(1) residuals/tick; ppermute stays out
+                    run_tick = jax.checkpoint(run_tick)
+
+                def tick(carry, t):
+                    state, loss_sum = carry
+                    outv, l = run_tick(state, t)
+                    m_out = t - (S - 1)
                     loss_sum = loss_sum + jnp.where(
                         (idx == S - 1) & (m_out >= 0), l, 0.0)
                     state = lax.ppermute(outv, "pipe", perm)

@@ -78,7 +78,11 @@ def _resolve_encoding(net, prompt_ids, one_hot: Optional[bool],
                 "one_hot= explicitly for a multi-input ComputationGraph")
     if one_hot and vocab_size is None:
         if sequential:
-            vocab_size = net.layers[-1].n_out
+            # input-side rule: the first layer consumes the one-hot vector,
+            # so ITS n_in is the width (asymmetric-vocab nets diverge from
+            # the head's n_out); head n_out only as a last resort
+            vocab_size = (getattr(net.layers[0], "n_in", None)
+                          if net.layers else None) or net.layers[-1].n_out
         elif single_in:
             in_name = net.conf.inputs[0]
             consumer = next((net.nodes[n] for n in net.topo

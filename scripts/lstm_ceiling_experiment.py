@@ -31,9 +31,12 @@ Run on any platform; writes profiles/lstm_ceiling.json.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _time(fn, warmup=2, iters=5):

@@ -26,9 +26,12 @@ Writes profiles/grad_sync.json and prints one JSON line.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 RESNET50_PARAMS = 25_557_032          # fc + conv + bn weights, our zoo config
 DTYPE_BYTES = 4                       # grads sync in f32

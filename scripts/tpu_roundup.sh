@@ -22,4 +22,8 @@ timeout 1200 python profile_tpu.py || { echo "profiling FAILED"; rc=1; }
 echo "== bench"
 timeout 1800 python bench.py || { echo "bench FAILED"; rc=1; }
 
+echo "== LSTM ceiling experiment (on-chip rerun; PROFILE.md round-5 row)"
+timeout 900 python scripts/lstm_ceiling_experiment.py \
+  || { echo "lstm ceiling FAILED"; rc=1; }
+
 exit $rc

@@ -215,3 +215,22 @@ def test_cg_one_hot_vocab_inferred_from_input_consumer():
     prompt = rs.randint(0, 30, (2, 3))
     out = generate(net, prompt, 4, temperature=0.0)  # would crash at 11
     assert out.shape == (2, 4) and out.max() < 11
+
+
+def test_mln_one_hot_vocab_inferred_from_first_layer():
+    """Asymmetric vocab, sequential net: one-hot width = first layer's
+    n_in (30), not the head's n_out (11) — same input-side rule as CG."""
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import GravesLSTM, RnnOutputLayer
+
+    b = (NeuralNetConfiguration.builder().seed(12)
+         .updater("sgd", learning_rate=0.1).list()
+         .layer(GravesLSTM(n_in=30, n_out=10))
+         .layer(RnnOutputLayer(n_in=10, n_out=11, loss="mcxent",
+                               activation="softmax")))
+    net = MultiLayerNetwork(b.build()).init()
+    rs = np.random.RandomState(12)
+    prompt = rs.randint(0, 30, (2, 3))
+    out = generate(net, prompt, 4, temperature=0.0)
+    assert out.shape == (2, 4) and out.max() < 11

@@ -84,3 +84,59 @@ def test_scanned_rejects_unsupported():
     net = _mlp()
     with pytest.raises(ValueError, match="scan_steps"):
         net.fit_scanned(_batches(2), scan_steps=0)
+
+
+# ------------------------------------------------------ ComputationGraph
+def _cg(seed=11):
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+    from deeplearning4j_tpu.models.vertices import MergeVertex
+
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater("adam", learning_rate=1e-2).graph()
+            .add_inputs("in")
+            .add_layer("d0", DenseLayer(n_in=12, n_out=8,
+                                        activation="tanh"), "in")
+            .add_layer("d1", DenseLayer(n_in=12, n_out=8,
+                                        activation="relu"), "in")
+            .add_vertex("m", MergeVertex(), "d0", "d1")
+            .add_layer("out", OutputLayer(n_in=16, n_out=4, loss="mcxent",
+                                          activation="softmax"), "m")
+            .set_outputs("out").build())
+    return ComputationGraph(conf).init()
+
+
+@pytest.mark.parametrize("n_batches,k", [(8, 4), (7, 4)])
+def test_cg_scanned_matches_per_batch(n_batches, k):
+    """Round 5: the K-step scan covers ComputationGraph too — same oracle
+    (bitwise-close params vs the per-batch path over the same batches)."""
+    data = _batches(n_batches, seed=4)
+    a = _cg()
+    for x, y in data:
+        a.fit(x, y)
+    b = _cg()
+    b.fit_scanned(data, scan_steps=k)
+    assert b.iteration == a.iteration == n_batches
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[ln][pn]), np.asarray(b.params[ln][pn]),
+                rtol=1e-6, atol=1e-7, err_msg=f"{ln}/{pn}")
+
+
+def test_cg_scanned_multidataset_and_guards():
+    from deeplearning4j_tpu.datasets.multidataset import MultiDataSet
+
+    data = _batches(4, seed=5)
+    mds = [MultiDataSet([x], [y]) for x, y in data]
+    a = _cg(seed=12)
+    for x, y in data:
+        a.fit(x, y)
+    b = _cg(seed=12)
+    b.fit_scanned(mds, scan_steps=4)
+    for ln in a.params:
+        for pn in a.params[ln]:
+            np.testing.assert_allclose(
+                np.asarray(a.params[ln][pn]), np.asarray(b.params[ln][pn]),
+                rtol=1e-6, atol=1e-7, err_msg=f"{ln}/{pn}")
+    with pytest.raises(ValueError, match="scan_steps"):
+        b.fit_scanned(mds, scan_steps=0)
