@@ -51,6 +51,10 @@ from deeplearning4j_tpu.nlp.stopwords import (
 from deeplearning4j_tpu.nlp.annotation import (
     TextAnnotator, pos_tag, sentiment_score, split_sentences,
 )
+from deeplearning4j_tpu.nlp.treeparser import (
+    BinarizeTreeTransformer, CollapseUnaries, HeadWordFinder, Tree,
+    TreeParser, TreeVectorizer,
+)
 from deeplearning4j_tpu.nlp.windows import Window, windows
 
 __all__ = [
@@ -66,5 +70,7 @@ __all__ = [
     "StaticWord2Vec", "Word2Vec", "WordVectors", "WordVectorSerializer",
     "StopWordsRemover", "get_stop_words", "is_stop_word",
     "remove_stop_words", "TextAnnotator", "pos_tag", "sentiment_score",
-    "split_sentences", "Window", "windows",
+    "split_sentences", "BinarizeTreeTransformer", "CollapseUnaries",
+    "HeadWordFinder", "Tree", "TreeParser", "TreeVectorizer",
+    "Window", "windows",
 ]
