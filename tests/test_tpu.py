@@ -328,3 +328,71 @@ def test_flash_attention_windowed_compiled_parity():
             np.testing.assert_allclose(
                 np.asarray(b) / scale, np.asarray(a) / scale, atol=2e-2,
                 err_msg=f"d{name} window={window}")
+
+
+def test_compiled_decode_scan_on_chip():
+    """Round 5: the one-XLA-program decode (prefill + lax.scan + sampling)
+    compiles and runs on the chip; greedy determinism across calls."""
+    from deeplearning4j_tpu.models.decode import generate
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    net = transformer_char_lm(vocab_size=64, d_model=64, n_heads=4,
+                              layers=2, max_cache=128,
+                              compute_dtype="bfloat16")
+    rs = np.random.RandomState(0)
+    prompt = rs.randint(0, 64, (4, 8))
+    a = generate(net, prompt, 32, temperature=0.0)
+    b = generate(net, prompt, 32, temperature=0.0)
+    assert a.shape == (4, 32)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_scanned_fit_amortizes_dispatch_floor_on_chip():
+    """Round-3 task 7's on-chip 'done' gate: with the K-step lax.scan
+    window in place, the amortized step must beat the per-step path (the
+    ~1 ms host/tunnel dispatch floor, PROFILE.md) — and by enough to call
+    the floor amortized, not noise."""
+    import time
+
+    from deeplearning4j_tpu.models.zoo import lenet
+
+    net = lenet(updater="nesterovs", lr=0.01)
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.rand(128, 28, 28, 1).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rs.randint(0, 10, 128)])
+
+    step = net._get_train_step()
+    s = [net.params, net.updater_state, net.net_state]
+    loss = None
+    for _ in range(5):
+        s[0], s[1], s[2], loss, _ = step(s[0], s[1], s[2], jnp.zeros(()),
+                                         x, y, net._keys.next(),
+                                         None, None, None)
+    np.asarray(jax.device_get(loss))
+    t0 = time.perf_counter()
+    for _ in range(50):
+        s[0], s[1], s[2], loss, _ = step(s[0], s[1], s[2], jnp.zeros(()),
+                                         x, y, net._keys.next(),
+                                         None, None, None)
+    np.asarray(jax.device_get(loss))
+    per_step = (time.perf_counter() - t0) / 50
+
+    K = 32
+    scanned = net._make_scanned_step()
+    xs = jnp.broadcast_to(x, (K,) + x.shape)
+    ys = jnp.broadcast_to(y, (K,) + y.shape)
+    ss = [s[0], s[1], s[2]]
+    keys = lambda: jnp.stack([net._keys.next() for _ in range(K)])
+    ss[0], ss[1], ss[2], l = scanned(ss[0], ss[1], ss[2], jnp.zeros(()),
+                                     xs, ys, keys())
+    np.asarray(jax.device_get(l))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        ss[0], ss[1], ss[2], l = scanned(ss[0], ss[1], ss[2], jnp.zeros(()),
+                                         xs, ys, keys())
+    np.asarray(jax.device_get(l))
+    amortized = (time.perf_counter() - t0) / 5 / K
+
+    assert amortized < per_step * 0.5, (
+        f"scan should amortize the dispatch floor: per-step "
+        f"{per_step*1e3:.3f} ms vs amortized {amortized*1e3:.3f} ms")
