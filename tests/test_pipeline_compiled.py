@@ -375,3 +375,44 @@ def test_remat_rejected_on_orchestrated_mode():
         PipelineParallelTrainingMaster(n_stages=2, mode="orchestrated",
                                        remat=True,
                                        devices=jax.devices()[:2])
+
+
+def test_hetero_sharded_randomized_config_sweep():
+    """Seeded property sweep over the sharded-hetero config space: random
+    widths/depths/updaters/stage counts must all match serial training
+    (the flat-row layout has per-config offsets — exercise many)."""
+    rs = np.random.RandomState(77)
+    for trial in range(6):
+        depth = int(rs.randint(3, 7))
+        widths = [int(rs.choice([6, 10, 14, 18, 22])) for _ in range(depth)]
+        updater = ["sgd", "nesterovs", "adam", "rmsprop"][trial % 4]
+        n_stages = int(rs.choice([2, 3, 4]))
+        n_micro = int(rs.choice([2, 4]))
+        acts = ["tanh", "relu", "sigmoid"]
+
+        def make():
+            b = (NeuralNetConfiguration.builder().seed(100 + trial)
+                 .updater(updater, learning_rate=0.05).list())
+            prev = 8
+            for i, w in enumerate(widths):
+                b.layer(DenseLayer(n_in=prev, n_out=w,
+                                   activation=acts[i % 3]))
+                prev = w
+            b.layer(OutputLayer(n_in=prev, n_out=4))
+            return MultiLayerNetwork(b.build()).init()
+
+        x, y = data(n_micro * 8, seed=trial)
+        serial = make()
+        serial.fit(x, y)
+        net = make()
+        master = _fit_pp(net, x, y, n_stages, n_micro, epochs=1)
+        cfg = (f"trial {trial}: widths={widths} updater={updater} "
+               f"S={n_stages} M={n_micro}")
+        assert master._compiled_kind == "hetero", cfg
+        assert master._hetero_sharded, cfg
+        for ln in serial.params:
+            for pn in serial.params[ln]:
+                np.testing.assert_allclose(
+                    np.asarray(serial.params[ln][pn]),
+                    np.asarray(net.params[ln][pn]), atol=3e-5,
+                    err_msg=f"{cfg}: {ln}/{pn}")
