@@ -3,6 +3,7 @@ from deeplearning4j_tpu.backend.device import (
     device_count,
     local_devices,
     dtype_policy,
+    slice_mesh,
     DTypePolicy,
 )
 from deeplearning4j_tpu.backend.rng import KeyStream

@@ -68,6 +68,86 @@ def default_mesh(
     return Mesh(dev_array, (AXIS_DATA, AXIS_MODEL, AXIS_SEQ))
 
 
+def slice_mesh(
+    n_slices: Optional[int] = None,
+    *,
+    model: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Multi-slice (DCN-spanning) mesh with the standard ('data', 'model',
+    'seq') axes, laid out so the expensive hop is crossed ONCE.
+
+    On multi-slice TPU, chips within a slice talk over ICI (fast) and
+    slices talk over DCN (slow).  XLA lowers a psum over the data axis to
+    a hierarchical all-reduce determined purely by DEVICE ORDER: with each
+    slice's chips contiguous along the data axis, the reduction runs
+    ring/tree within each slice over ICI first and exchanges one
+    slice-level partial over DCN — the scaling-book recipe.  This helper
+    groups devices by their ``slice_index`` attribute (real multi-slice
+    platforms) or into ``n_slices`` contiguous groups (virtual meshes),
+    then hands back a mesh every existing TrainingMaster accepts
+    unchanged: hierarchical DP needs no new API, only the right order.
+
+    Model/seq axes are kept INSIDE a slice (their collectives are
+    per-layer, far too chatty for DCN): each slice must hold a whole
+    model*seq block.  Reference analog: none — the reference's Spark
+    aggregation tree (``ParameterAveragingTrainingMaster.java:628-645``)
+    is the closest concept, with the driver as the (single) slow hop.
+    """
+    if devices is None:
+        devices = list(jax.devices())
+    ordered, per_slice = _group_by_slice(devices, n_slices)
+    if per_slice % (model * seq) != 0:
+        raise ValueError(
+            f"model*seq={model * seq} must divide the {per_slice} "
+            "devices of each slice (TP/SP collectives must stay on "
+            "ICI — a model/seq group cannot straddle DCN)")
+    return default_mesh(devices=ordered, model=model, seq=seq)
+
+
+def _group_by_slice(devices: Sequence, n_slices: Optional[int]):
+    """Order devices slice-contiguously; returns (ordered, per_slice).
+
+    Real multi-slice platforms carry a ``slice_index`` device attribute —
+    devices regroup by it (sorted by slice, original order within a
+    slice) even when ``jax.devices()`` interleaves slices.  Without the
+    attribute (CPU/virtual meshes), devices split into ``n_slices`` equal
+    contiguous groups.  Kept as a pure function so the regrouping is
+    testable with stub devices.  (Deliberately NOT
+    ``mesh_utils.create_hybrid_device_mesh``: that helper exposes DCN as
+    a SEPARATE mesh axis, while this layout folds slices into the data
+    axis so every existing TrainingMaster works unchanged — hierarchical
+    reduction then comes from device order alone.)
+    """
+    has_attr = [getattr(d, "slice_index", None) for d in devices]
+    if all(si is None for si in has_attr):
+        k = n_slices or 1
+        if len(devices) % k != 0:
+            raise ValueError(
+                f"{len(devices)} devices (no slice_index attribute — "
+                f"virtual slicing) are not divisible into n_slices={k} "
+                "equal groups")
+        per = len(devices) // k
+        return list(devices), per
+    groups: dict = {}
+    for d, si in zip(devices, has_attr):
+        groups.setdefault(si if si is not None else -1, []).append(d)
+    if n_slices is not None and len(groups) != n_slices:
+        raise ValueError(
+            f"n_slices={n_slices} but the platform reports "
+            f"{len(groups)} slice(s) (slice_index values: "
+            f"{sorted(groups)})")
+    sizes = {len(g) for g in groups.values()}
+    if len(sizes) != 1:
+        raise ValueError("unequal devices per slice: "
+                         f"{[len(groups[s]) for s in sorted(groups)]}")
+    ordered: list = []
+    for si in sorted(groups):
+        ordered.extend(groups[si])
+    return ordered, sizes.pop()
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
