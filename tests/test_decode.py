@@ -234,3 +234,23 @@ def test_mln_one_hot_vocab_inferred_from_first_layer():
     prompt = rs.randint(0, 30, (2, 3))
     out = generate(net, prompt, 4, temperature=0.0)
     assert out.shape == (2, 4) and out.max() < 11
+
+
+def test_generate_identical_after_zip_round_trip(tmp_path):
+    """Serialization composes with the compiled decode: save -> load ->
+    generate must reproduce the original tokens exactly (config carries
+    GQA/window/max_cache; params + updater state ride the zip)."""
+    from deeplearning4j_tpu.models.serialization import load_model
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    net = transformer_char_lm(vocab_size=19, d_model=16, n_heads=4,
+                              layers=2, n_kv_heads=2, window=16,
+                              max_cache=32)
+    rs = np.random.RandomState(5)
+    prompt = rs.randint(0, 19, (2, 4))
+    before = generate(net, prompt, 10, temperature=0.0)
+    path = tmp_path / "lm.zip"
+    net.save(str(path))
+    loaded = load_model(str(path))
+    after = generate(loaded, prompt, 10, temperature=0.0)
+    np.testing.assert_array_equal(before, after)
