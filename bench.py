@@ -110,8 +110,17 @@ def _peak_flops(device) -> float:
 
 def _compile_step(jitted, *args):
     """AOT-compile once; return (flops, compiled executable).  The timing
-    loops call the executable directly so the model is never compiled twice."""
-    compiled = jitted.lower(*args).compile()
+    loops call the executable directly so the model is never compiled twice.
+    Each AOT compile is counted in the metrics registry so the bench
+    snapshot carries compile counts next to the timings."""
+    from deeplearning4j_tpu.observability import get_registry
+    from deeplearning4j_tpu.observability.recompile import compile_counter
+
+    with get_registry().histogram(
+            "dl4j_compile_seconds",
+            "Wall time of AOT step compilations (bench)").time():
+        compiled = jitted.lower(*args).compile()
+    compile_counter("bench.aot").inc()
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -683,16 +692,21 @@ def main():
     platform = dev.platform
     peak = _peak_flops(dev)
 
+    from deeplearning4j_tpu.observability import PhaseTimers, get_registry
+
+    phases = PhaseTimers("bench")
     metrics = []
     errors = []
-    for fn in (lambda: bench_resnet50(platform, baselines, peak),
-               lambda: bench_lenet(platform, baselines),
-               lambda: bench_graves_lstm(platform, baselines, peak),
-               lambda: bench_transformer(platform, baselines, peak),
-               lambda: bench_decode(platform, peak),
-               lambda: bench_long_context(platform, peak)):
+    for name, fn in (
+            ("resnet50", lambda: bench_resnet50(platform, baselines, peak)),
+            ("lenet", lambda: bench_lenet(platform, baselines)),
+            ("graves_lstm", lambda: bench_graves_lstm(platform, baselines, peak)),
+            ("transformer", lambda: bench_transformer(platform, baselines, peak)),
+            ("decode", lambda: bench_decode(platform, peak)),
+            ("long_context", lambda: bench_long_context(platform, peak))):
         try:
-            metrics.append(fn())
+            with phases.phase(name):
+                metrics.append(fn())
         except Exception as e:
             errors.append(str(e)[:300])
     if not metrics:
@@ -711,6 +725,14 @@ def main():
         "baseline_source": ("baseline_cpu.json (torch-CPU, reproduce with "
                             "bench_baseline_cpu.py)"),
         "all": metrics,
+        # telemetry snapshot: compile counts, per-bench phase timing, and
+        # any fit/serving metrics recorded during the run — lands in
+        # bench_full.json so BENCH_*.json gains compile-count and
+        # phase-timing fields next to the timings
+        "observability": {
+            "bench_phases": phases.as_dict(),
+            "registry": get_registry().to_json(),
+        },
     }
     if errors:
         full["errors"] = errors

@@ -1,0 +1,57 @@
+"""JAX version compatibility shims for the SPMD modules.
+
+The sharded-training code targets the modern jax surface (``jax.shard_map``
+with ``check_vma``, ``lax.pcast`` varying-ness casts).  On older jax
+(0.4.x) those live elsewhere or don't exist:
+
+- ``shard_map``: ``jax.experimental.shard_map.shard_map``, whose
+  ``check_rep`` kwarg is the predecessor of ``check_vma``.
+- ``lax.pcast``: absent.  With replication checking OFF (every call site
+  here passes ``check_vma=False``) pcast only adjusts the varying-ness
+  *type* of a value, never its data — so the identity function is the
+  correct fallback.
+
+Import from here instead of ``jax`` so the parallel/nlp modules load (and
+run) on both vintages.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax as _lax
+
+try:  # modern home
+    from jax import shard_map as _shard_map_impl  # type: ignore[attr-defined]
+except ImportError:  # 0.4.x experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+# key the kwarg translation on the SIGNATURE, not the import location —
+# there are jax vintages with a top-level shard_map that still takes
+# check_rep (the check_vma rename landed separately)
+import inspect as _inspect
+
+try:
+    _HAS_CHECK_VMA = "check_vma" in _inspect.signature(
+        _shard_map_impl).parameters
+except (TypeError, ValueError):  # unintrospectable: assume modern
+    _HAS_CHECK_VMA = True
+
+if _HAS_CHECK_VMA:
+    shard_map = _shard_map_impl
+else:
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kw):
+        if f is None:  # decorator form: shard_map(mesh=..., ...)(f)
+            return lambda fn: shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                        out_specs=out_specs,
+                                        check_vma=check_vma, **kw)
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_rep=check_vma,
+                               **kw)
+
+
+if hasattr(_lax, "pcast"):
+    pcast = _lax.pcast
+else:
+    def pcast(x, axes, to=None):
+        return x

@@ -36,6 +36,22 @@ class LazyScoreMixin:
         self._score = value
 
 
+def notify_listeners(model, batch_size=None) -> None:
+    """Fire ``iteration_done`` on the model's listeners, first wiring the
+    actual minibatch size into any listener that wants it (fixes
+    ``PerformanceListener`` reporting no samples/sec unless the user called
+    ``set_batch_size`` by hand — the fit loop knows the batch, so it tells
+    the listeners).  Also mirrors it as ``model.last_batch_size``."""
+    if batch_size is not None:
+        model.last_batch_size = int(batch_size)
+    for lst in model.listeners:
+        if batch_size is not None:
+            setter = getattr(lst, "set_batch_size", None)
+            if setter is not None:
+                setter(int(batch_size))
+        lst.iteration_done(model, model.iteration)
+
+
 def seed_stream_caches(named_layers, rnn_state, batch, compute_dtype):
     """Streaming-cache seeding shared by both facades' ``rnn_time_step``:
     for every (name, layer) with an ``init_cache`` and no existing carry,

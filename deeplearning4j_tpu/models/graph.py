@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -22,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.backend.rng import KeyStream
-from deeplearning4j_tpu.models.common import LazyScoreMixin
+from deeplearning4j_tpu.models.common import LazyScoreMixin, notify_listeners
+from deeplearning4j_tpu.observability import fit_telemetry, instrument
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn.conf import UpdaterConfig
 from deeplearning4j_tpu.nn.inputs import InputType
@@ -473,8 +475,10 @@ class ComputationGraph(LazyScoreMixin):
 
     def _get_train_step(self):
         if "train_step" not in self._jit_cache:
-            self._jit_cache["train_step"] = jax.jit(
-                self._step_core(), donate_argnums=(0, 1, 2))
+            self._jit_cache["train_step"] = instrument(
+                jax.jit(self._step_core(), donate_argnums=(0, 1, 2)),
+                "ComputationGraph.train_step",
+                argnums=(3, 4, 5, 6, 7, 8, 9))
         return self._jit_cache["train_step"]
 
     def _make_scanned_step(self):
@@ -497,7 +501,9 @@ class ComputationGraph(LazyScoreMixin):
                 body, (params, upd_state, net_state, it0), (xs, ys, rngs))
             return params, upd_state, net_state, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return instrument(jax.jit(multi, donate_argnums=(0, 1, 2)),
+                          "ComputationGraph.scanned_step",
+                          argnums=(3, 4, 5, 6))
 
     def fit_scanned(self, batches, scan_steps: int, epochs: int = 1):
         """Amortized training: consecutive same-shape batches stacked
@@ -551,19 +557,26 @@ class ComputationGraph(LazyScoreMixin):
 
     def _flush_window(self, window, scanned, scan_steps):
         if len(window) == scan_steps:
-            xs = {k: jnp.asarray(np.stack([b[0][k] for b in window]))
-                  for k in window[0][0]}
-            ys = {k: jnp.asarray(np.stack([b[1][k] for b in window]))
-                  for k in window[0][1]}
-            rngs = jnp.stack([self._keys.next() for _ in window])
-            it0 = jnp.asarray(self.iteration, jnp.float32)
-            (self.params, self.updater_state, self.net_state,
-             losses) = scanned(self.params, self.updater_state,
-                               self.net_state, it0, xs, ys, rngs)
+            tel = fit_telemetry("ComputationGraph")
+            batch = len(next(iter(window[0][0].values())))
+            t0 = time.perf_counter()
+            with tel.span(self.iteration):
+                xs = {k: jnp.asarray(np.stack([b[0][k] for b in window]))
+                      for k in window[0][0]}
+                ys = {k: jnp.asarray(np.stack([b[1][k] for b in window]))
+                      for k in window[0][1]}
+                rngs = jnp.stack([self._keys.next() for _ in window])
+                it0 = jnp.asarray(self.iteration, jnp.float32)
+                (self.params, self.updater_state, self.net_state,
+                 losses) = scanned(self.params, self.updater_state,
+                                   self.net_state, it0, xs, ys, rngs)
             self.score_value = losses[-1]
             self.iteration += len(window)
-            for lst in self.listeners:
-                lst.iteration_done(self, self.iteration)
+            tel.record_step(time.perf_counter() - t0, batch, losses[-1],
+                            steps=len(window), model=self)
+            # listeners fire once per window, so they get the WINDOW's
+            # sample count — samples/sec = samples / (window wall time)
+            notify_listeners(self, batch * len(window))
         else:  # short tail: regular per-batch step keeps semantics exact
             for x, y in window:
                 self._one_step(x, y, None, None, carries=None)
@@ -625,17 +638,22 @@ class ComputationGraph(LazyScoreMixin):
         step = self._get_train_step()
         x = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x))
         y = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(y))
-        (self.params, self.updater_state, self.net_state, loss, new_carries) = step(
-            self.params, self.updater_state, self.net_state,
-            jnp.asarray(float(self.iteration)), x, y, self._keys.next(),
-            None if fm is None else jax.tree_util.tree_map(jnp.asarray, fm),
-            None if lm is None else jax.tree_util.tree_map(jnp.asarray, lm),
-            carries,
-        )
+        batch = int(next(iter(x.values())).shape[0]) if x else None
+        tel = fit_telemetry("ComputationGraph")
+        t0 = time.perf_counter()
+        with tel.span(self.iteration):
+            (self.params, self.updater_state, self.net_state, loss,
+             new_carries) = step(
+                self.params, self.updater_state, self.net_state,
+                jnp.asarray(float(self.iteration)), x, y, self._keys.next(),
+                None if fm is None else jax.tree_util.tree_map(jnp.asarray, fm),
+                None if lm is None else jax.tree_util.tree_map(jnp.asarray, lm),
+                carries,
+            )
         self.score_value = loss  # device scalar; fetched lazily on read
         self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration)
+        tel.record_step(time.perf_counter() - t0, batch, loss, model=self)
+        notify_listeners(self, batch)
         return new_carries
 
     def _fit_tbptt(self, x, y, fm, lm):

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.backend.rng import KeyStream
-from deeplearning4j_tpu.models.common import LazyScoreMixin
+from deeplearning4j_tpu.models.common import LazyScoreMixin, notify_listeners
+from deeplearning4j_tpu.observability import fit_telemetry, instrument
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import Layer
@@ -207,7 +208,9 @@ class MultiLayerNetwork(LazyScoreMixin):
         return step
 
     def _make_train_step(self, with_carry: bool):
-        return jax.jit(self._step_core(), donate_argnums=(0, 1, 2))
+        return instrument(jax.jit(self._step_core(), donate_argnums=(0, 1, 2)),
+                          "MultiLayerNetwork.train_step",
+                          argnums=(3, 4, 5, 6, 7, 8, 9))
 
     def _make_scanned_step(self):
         """K weight updates in ONE dispatch: ``lax.scan`` over the step
@@ -231,7 +234,9 @@ class MultiLayerNetwork(LazyScoreMixin):
                 body, (params, upd_state, net_state, it0), (xs, ys, rngs))
             return params, upd_state, net_state, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1, 2))
+        return instrument(jax.jit(multi, donate_argnums=(0, 1, 2)),
+                          "MultiLayerNetwork.scanned_step",
+                          argnums=(3, 4, 5, 6))
 
     def fit_scanned(self, batches, scan_steps: int, epochs: int = 1):
         """Amortized training: consecutive same-shape minibatches are
@@ -278,17 +283,23 @@ class MultiLayerNetwork(LazyScoreMixin):
 
     def _flush_window(self, window, scanned, step, scan_steps):
         if len(window) == scan_steps:
-            xs = jnp.asarray(np.stack([b[0] for b in window]))
-            ys = jnp.asarray(np.stack([b[1] for b in window]))
-            rngs = jnp.stack([self._keys.next() for _ in window])
-            it0 = jnp.asarray(self.iteration, jnp.float32)
-            (self.params, self.updater_state, self.net_state,
-             losses) = scanned(self.params, self.updater_state,
-                               self.net_state, it0, xs, ys, rngs)
+            tel = fit_telemetry("MultiLayerNetwork")
+            t0 = time.perf_counter()
+            with tel.span(self.iteration):
+                xs = jnp.asarray(np.stack([b[0] for b in window]))
+                ys = jnp.asarray(np.stack([b[1] for b in window]))
+                rngs = jnp.stack([self._keys.next() for _ in window])
+                it0 = jnp.asarray(self.iteration, jnp.float32)
+                (self.params, self.updater_state, self.net_state,
+                 losses) = scanned(self.params, self.updater_state,
+                                   self.net_state, it0, xs, ys, rngs)
             self.score_value = losses[-1]
             self.iteration += len(window)
-            for lst in self.listeners:
-                lst.iteration_done(self, self.iteration)
+            tel.record_step(time.perf_counter() - t0, len(window[0][0]),
+                            losses[-1], steps=len(window), model=self)
+            # listeners fire once per window, so they get the WINDOW's
+            # sample count — samples/sec = samples / (window wall time)
+            notify_listeners(self, len(window[0][0]) * len(window))
         else:   # short tail: regular per-batch step keeps semantics exact
             for x, y in window:
                 self._one_step(step, x, y, None, None, carries=None)
@@ -351,17 +362,22 @@ class MultiLayerNetwork(LazyScoreMixin):
     def _one_step(self, step, x, y, fm, lm, carries):
         rng = self._keys.next()
         it = jnp.asarray(self.iteration, jnp.float32)
-        (self.params, self.updater_state, self.net_state, loss, new_carries) = step(
-            self.params, self.updater_state, self.net_state, it,
-            jnp.asarray(x), jnp.asarray(y), rng,
-            None if fm is None else jnp.asarray(fm),
-            None if lm is None else jnp.asarray(lm),
-            carries,
-        )
+        tel = fit_telemetry("MultiLayerNetwork")
+        t0 = time.perf_counter()
+        with tel.span(self.iteration):
+            (self.params, self.updater_state, self.net_state, loss,
+             new_carries) = step(
+                self.params, self.updater_state, self.net_state, it,
+                jnp.asarray(x), jnp.asarray(y), rng,
+                None if fm is None else jnp.asarray(fm),
+                None if lm is None else jnp.asarray(lm),
+                carries,
+            )
         self.score_value = loss  # device scalar; fetched lazily on read
         self.iteration += 1
-        for lst in self.listeners:
-            lst.iteration_done(self, self.iteration)
+        tel.record_step(time.perf_counter() - t0, int(np.shape(x)[0]), loss,
+                        model=self)
+        notify_listeners(self, int(np.shape(x)[0]))
         return new_carries
 
     def _fit_tbptt(self, step, x, y, fm, lm):

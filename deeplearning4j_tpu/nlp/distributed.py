@@ -31,7 +31,7 @@ from typing import Iterable, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from deeplearning4j_tpu.backend.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from deeplearning4j_tpu.backend import device as backend

@@ -1,0 +1,38 @@
+"""Unified telemetry core: metrics registry, span tracing, recompile
+detection, device-memory gauges, phase timers.
+
+The shared data model the reference never had (its observability is
+scattered over ``IterationListener`` hooks, ``PerformanceListener``
+sampling and the SBE ``StatsListener`` pipeline): everything in this
+framework — fit loops, parallel training masters, the pipeline master,
+the inference server, ``ui.stats`` — records into ONE process-wide
+``MetricsRegistry``, exportable as JSON or Prometheus text (served live
+from ``InferenceServer`` at ``/metrics``).  See docs/observability.md.
+"""
+
+from deeplearning4j_tpu.observability.metrics import (
+    DEFAULT_BUCKETS, Counter, Gauge, Histogram, MetricFamily,
+    MetricsRegistry, get_registry, set_registry,
+)
+from deeplearning4j_tpu.observability.tracing import (
+    Span, SpanTracer, get_tracer, set_tracer,
+)
+from deeplearning4j_tpu.observability.recompile import (
+    RecompileDetector, compile_counter, fingerprint, instrument,
+)
+from deeplearning4j_tpu.observability.memory import (
+    DeviceMemoryMonitor, device_memory_stats, sample_once,
+)
+from deeplearning4j_tpu.observability.phases import PhaseTimers
+from deeplearning4j_tpu.observability.fitmetrics import (
+    FitTelemetry, fit_telemetry,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
+    "MetricsRegistry", "get_registry", "set_registry",
+    "Span", "SpanTracer", "get_tracer", "set_tracer",
+    "RecompileDetector", "compile_counter", "fingerprint", "instrument",
+    "DeviceMemoryMonitor", "device_memory_stats", "sample_once",
+    "PhaseTimers", "FitTelemetry", "fit_telemetry",
+]

@@ -1,0 +1,96 @@
+"""Device-memory gauges: PJRT ``memory_stats()`` sampled into the registry.
+
+≙ the reference's JVM/GC memory MX-bean sampling in ``StatsListener.java``
+— here the scarce resource is HBM, and PJRT exposes it per device.  CPU
+backends typically return no stats; everything degrades to a graceful
+no-op there (the gauges simply never appear).
+
+``DeviceMemoryMonitor`` samples on a configurable interval from a daemon
+thread; ``sample_once()`` is the synchronous one-shot both the monitor and
+``ui.stats.StatsListener`` reports share.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_GAUGE = "dl4j_device_memory_bytes"
+_STATS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_stats() -> Dict[str, Any]:
+    """Per-device PJRT memory stats; empty dict when unavailable (CPU)."""
+    import jax
+
+    out: Dict[str, Any] = {}
+    for i, d in enumerate(jax.local_devices()):
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            out[f"device_{i}"] = {k: ms.get(k) for k in _STATS}
+    return out
+
+
+def sample_once(registry=None) -> Dict[str, Any]:
+    """One sample: fetch PJRT stats and mirror them into registry gauges
+    ``dl4j_device_memory_bytes{device=..., stat=...}``.  Returns the raw
+    per-device dict (the shape ``ui.stats`` reports embed)."""
+    from deeplearning4j_tpu.observability.metrics import get_registry
+
+    stats = device_memory_stats()
+    if stats:
+        fam = (registry if registry is not None else get_registry()).gauge(
+            _GAUGE, "PJRT per-device memory stats (absent on backends "
+            "without memory_stats, e.g. CPU)", labels=("device", "stat"))
+        for dev, per in stats.items():
+            for stat, v in per.items():
+                if v is not None:
+                    fam.set(v, device=dev, stat=stat)
+    return stats
+
+
+class DeviceMemoryMonitor:
+    """Background sampler: calls ``sample_once`` every ``interval_s``
+    seconds from a daemon thread until ``stop()``.
+
+    Usage::
+
+        mon = DeviceMemoryMonitor(interval_s=10.0).start()
+        ...
+        mon.stop()
+    """
+
+    def __init__(self, interval_s: float = 10.0, registry=None):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.interval_s = float(interval_s)
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples = 0
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                sample_once(self._registry)
+                self.samples += 1
+            except Exception:
+                pass  # a flaky backend must not kill the sampler thread
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "DeviceMemoryMonitor":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="dl4j-memory-monitor", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

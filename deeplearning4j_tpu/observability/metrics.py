@@ -1,0 +1,404 @@
+"""Process-wide metrics registry: counters, gauges, histograms with labels.
+
+The data model every layer shares (SURVEY.md: the reference scatters timing
+over ``IterationListener`` / ``PerformanceListener`` / the SBE-encoded
+``StatsListener`` pipeline with no common store; SparkNet/DeepSpark show
+that distributed-throughput tuning needs one).  Naming follows Prometheus
+conventions — ``dl4j_`` prefix, base units (seconds, bytes), ``_total``
+suffix on counters — and the registry renders both JSON (``to_json``) and
+Prometheus text exposition format (``to_prometheus``).
+
+TPU-specific design point: gauges accept LAZY values — an on-device scalar
+(or a zero-arg callable) is stored as-is and only converted with
+``float()`` at scrape/render time, so the training hot loop never pays a
+device->host sync to record its score (the same contract as
+``LazyScoreMixin``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Latency buckets in SECONDS (Prometheus base unit), spanning the sub-ms
+# dispatch floor of LeNet-class steps up to multi-second ResNet/compile
+# events.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+def _as_float(v: Any) -> float:
+    """Resolve a lazily-stored gauge value (callable or device scalar)."""
+    if callable(v):
+        v = v()
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return float("nan")
+
+
+def _escape_label(v: Any) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(pairs: Sequence[Tuple[str, Any]]) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{_escape_label(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class Counter:
+    """Monotonically increasing value (one labeled child of a family)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter increment must be >= 0, got {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value; accepts lazy values (device scalar / callable)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value: Any = 0.0
+
+    def set(self, value: Any) -> None:
+        """Store without conversion: an on-device scalar stays on device
+        until scrape time (no sync in the hot loop)."""
+        self._value = value
+
+    def set_function(self, fn) -> None:
+        """Gauge computed at scrape time (e.g. a queue depth)."""
+        self._value = fn
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value = _as_float(self._value) + amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return _as_float(self._value)
+
+
+class Histogram:
+    """Cumulative-bucket histogram + running sum/count/min/max.
+
+    min/max are beyond the Prometheus exposition model but kept so
+    registry-backed phase timers can reproduce the ``PhaseStats.as_dict``
+    schema exactly (count/total/mean/min/max per phase).
+    """
+
+    __slots__ = ("_lock", "buckets", "_bucket_counts", "_sum", "_count",
+                 "_min", "_max")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self._lock = threading.Lock()
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._bucket_counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            if value < self._min:
+                self._min = value
+            if value > self._max:
+                self._max = value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._bucket_counts[i] += 1
+                    break
+
+    def time(self):
+        """Context manager observing the elapsed seconds of the block."""
+        return _HistogramTimer(self)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    @property
+    def min(self) -> float:
+        return self._min if self._count else float("nan")
+
+    @property
+    def max(self) -> float:
+        return self._max if self._count else float("nan")
+
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs, +Inf last."""
+        out, running = [], 0
+        with self._lock:
+            for b, c in zip(self.buckets, self._bucket_counts):
+                running += c
+                out.append((b, running))
+            out.append((math.inf, self._count))
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min if self._count else None,
+                "max": self._max if self._count else None,
+                "buckets": {
+                    _fmt_value(b): c
+                    for b, c in zip(self.buckets, self._bucket_counts)
+                },
+            }
+
+
+class _HistogramTimer:
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+
+    def __enter__(self):
+        import time
+
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricFamily:
+    """A named metric plus its labeled children.  With no declared labels
+    the family proxies its single unlabeled child, so
+    ``registry.counter("x").inc()`` works without a ``labels()`` hop."""
+
+    def __init__(self, name: str, kind: str, help: str,
+                 label_names: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._buckets = tuple(buckets)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[Any, ...], Any] = {}
+
+    def labels(self, **labels) -> Any:
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"metric {self.name!r} declares labels {self.label_names}, "
+                f"got {tuple(labels)}")
+        key = tuple(labels[k] for k in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                cls = _KINDS[self.kind]
+                child = (cls(self._buckets) if self.kind == "histogram"
+                         else cls())
+                self._children[key] = child
+            return child
+
+    # unlabeled convenience: family proxies its single child
+    def _default(self):
+        return self.labels()
+
+    def inc(self, amount: float = 1.0, **labels):
+        (self.labels(**labels) if labels else self._default()).inc(amount)
+
+    def set(self, value, **labels):
+        (self.labels(**labels) if labels else self._default()).set(value)
+
+    def set_function(self, fn, **labels):
+        (self.labels(**labels) if labels else self._default()).set_function(fn)
+
+    def observe(self, value, **labels):
+        (self.labels(**labels) if labels else self._default()).observe(value)
+
+    def time(self, **labels):
+        return (self.labels(**labels) if labels else self._default()).time()
+
+    def samples(self) -> List[Tuple[Tuple[Tuple[str, Any], ...], Any]]:
+        with self._lock:
+            items = list(self._children.items())
+        return [(tuple(zip(self.label_names, key)), child)
+                for key, child in items]
+
+    def get(self, **labels):
+        """Existing child or None (no implicit creation)."""
+        key = tuple(labels.get(k) for k in self.label_names)
+        with self._lock:
+            return self._children.get(key)
+
+
+class MetricsRegistry:
+    """Process-wide metric store; export as JSON or Prometheus text."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, MetricFamily] = {}
+
+    # ------------------------------------------------------------ creation
+    def _family(self, name: str, kind: str, help: str,
+                labels: Sequence[str], buckets=None) -> MetricFamily:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as {fam.kind}, "
+                        f"requested {kind}")
+                if tuple(labels) != fam.label_names:
+                    raise ValueError(
+                        f"metric {name!r} already registered with labels "
+                        f"{fam.label_names}, requested {tuple(labels)}")
+                return fam
+            fam = MetricFamily(name, kind, help, labels,
+                               buckets or DEFAULT_BUCKETS)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = ()) -> MetricFamily:
+        return self._family(name, "counter", help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = ()) -> MetricFamily:
+        return self._family(name, "gauge", help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> MetricFamily:
+        return self._family(name, "histogram", help, labels, buckets)
+
+    # ------------------------------------------------------------- reading
+    def families(self) -> List[MetricFamily]:
+        with self._lock:
+            return list(self._families.values())
+
+    def get(self, name: str) -> Optional[MetricFamily]:
+        with self._lock:
+            return self._families.get(name)
+
+    def get_value(self, name: str, **labels) -> Optional[float]:
+        """Scalar value of a counter/gauge child, or None if absent."""
+        fam = self.get(name)
+        if fam is None:
+            return None
+        child = fam.get(**labels) if labels else fam.get()
+        if child is None:
+            return None
+        return child.value if not isinstance(child, Histogram) else None
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for fam in self.families():
+            vals = []
+            for label_pairs, child in fam.samples():
+                entry: Dict[str, Any] = {"labels": dict(label_pairs)}
+                if isinstance(child, Histogram):
+                    entry.update(child.to_dict())
+                else:
+                    entry["value"] = child.value
+                vals.append(entry)
+            out[fam.name] = {"type": fam.kind, "help": fam.help,
+                             "values": vals}
+        return out
+
+    def to_json_str(self, **kw) -> str:
+        return json.dumps(self.to_json(), **kw)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        for fam in self.families():
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for label_pairs, child in fam.samples():
+                base = list(label_pairs)
+                if isinstance(child, Histogram):
+                    for bound, cum in child.cumulative_buckets():
+                        le = "+Inf" if math.isinf(bound) else _fmt_value(bound)
+                        lines.append(
+                            f"{fam.name}_bucket"
+                            f"{_fmt_labels(base + [('le', le)])} {cum}")
+                    lines.append(
+                        f"{fam.name}_sum{_fmt_labels(base)} "
+                        f"{_fmt_value(child.sum)}")
+                    lines.append(
+                        f"{fam.name}_count{_fmt_labels(base)} {child.count}")
+                else:
+                    lines.append(
+                        f"{fam.name}{_fmt_labels(base)} "
+                        f"{_fmt_value(child.value)}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        with self._lock:
+            self._families.clear()
+
+
+_global_lock = threading.Lock()
+_global_registry: Optional[MetricsRegistry] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (created on first use)."""
+    global _global_registry
+    with _global_lock:
+        if _global_registry is None:
+            _global_registry = MetricsRegistry()
+        return _global_registry
+
+
+def set_registry(registry: Optional[MetricsRegistry]) -> MetricsRegistry:
+    """Swap the process-wide registry (tests); returns the new one."""
+    global _global_registry
+    with _global_lock:
+        _global_registry = registry or MetricsRegistry()
+        return _global_registry

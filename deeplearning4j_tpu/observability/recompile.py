@@ -1,0 +1,212 @@
+"""Recompile detection for jitted step functions.
+
+On TPU the silent throughput killer is XLA retracing/recompilation from
+shape churn — a ragged final batch, a TBPTT tail window, a mask appearing
+mid-run — each costing seconds of compile against a millisecond step.
+Nothing in the reference detects this (it has no compiler in the loop).
+
+``instrument(jax.jit(step), "name")`` wraps the jitted callable: every call
+fingerprints the *abstract* signature of the inputs (pytree structure +
+shape/dtype/sharding per leaf — the things jit keys its cache on), counts
+distinct signatures as compiles in the metrics registry, and logs ONE
+warning per *new* signature after the first with the old→new delta, e.g.::
+
+    recompile #2 of MultiLayerNetwork.train_step: args[4]:
+    f32[128,784] -> f32[96,784]
+
+The fingerprint is a few microseconds of host work per call (tuple of
+shape/dtype ids per leaf); the paths needed for a readable delta are only
+computed on a miss.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+logger = logging.getLogger("deeplearning4j_tpu.observability")
+
+_COMPILES = "dl4j_compiles_total"
+_RECOMPILES = "dl4j_recompiles_total"
+
+
+def _leaf_sig(leaf: Any) -> Tuple:
+    """Abstract signature of one pytree leaf: what jit keys its cache on.
+    The sharding is kept as the OBJECT (hashable, cheap) — stringifying it
+    per call was the dominant fingerprint cost on large pytrees."""
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        # non-array static-ish leaf (python scalar, string…): jit treats
+        # python numbers as weak-typed 0-d arrays; keep the type
+        return (type(leaf).__name__,)
+    dtype = getattr(leaf, "dtype", None)
+    return (tuple(shape), str(dtype), getattr(leaf, "sharding", None))
+
+
+def _fmt_leaf_sig(sig: Tuple) -> str:
+    if len(sig) == 1:
+        return sig[0]
+    shape, dtype, sharding = sig
+    short = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
+             "int32": "i32", "int64": "i64", "bool": "b1",
+             "uint32": "u32"}.get(dtype, dtype)
+    s = f"{short}[{','.join(str(d) for d in shape)}]"
+    sh = "" if sharding is None else repr(sharding)
+    if sh and "SingleDevice" not in sh:
+        s += f"@{sh}"
+    return s
+
+
+def fingerprint(args: Tuple, kwargs: Dict) -> Tuple:
+    """Hashable abstract signature of a call's inputs."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (treedef, tuple(_leaf_sig(l) for l in leaves))
+
+
+def _leaf_paths(args: Tuple, kwargs: Dict) -> List[str]:
+    """Human-readable path per leaf, same order as ``fingerprint``."""
+    import jax
+
+    flat = jax.tree_util.tree_flatten_with_path((args, kwargs))[0]
+    out = []
+    for path, _ in flat:
+        label = jax.tree_util.keystr(path)
+        # keystr renders "(0,)[4]['w']" style; trim the (args, kwargs) root
+        if label.startswith("[0]"):
+            label = "args" + label[3:]
+        elif label.startswith("[1]"):
+            label = "kwargs" + label[3:]
+        out.append(label)
+    return out
+
+
+class RecompileDetector:
+    """Tracks abstract input signatures of one jitted function."""
+
+    def __init__(self, name: str, registry=None,
+                 warn: Optional[Callable[[str], None]] = None):
+        from deeplearning4j_tpu.observability.metrics import get_registry
+
+        self.name = name
+        self.warn = warn or logger.warning
+        self._lock = threading.Lock()
+        self._seen: Dict[Tuple, int] = {}   # signature -> compile ordinal
+        self._last: Optional[Tuple] = None
+        self.compile_count = 0
+        self.recompile_count = 0  # new signatures after the first
+        reg = registry if registry is not None else get_registry()
+        self._m_compiles = compile_counter(name, reg)
+        self._m_recompiles = reg.counter(
+            _RECOMPILES, "Signature changes after the first compile "
+            "(shape/dtype/sharding churn)", labels=("fn",)
+        ).labels(fn=name)
+
+    def check(self, args: Any, kwargs: Dict) -> bool:
+        """Record this call's signature (``args`` is any pytree — a tuple
+        of positional args, or a position-keyed dict when the wrapper
+        subsets by ``argnums``); returns True when it is new (i.e. this
+        call compiles)."""
+        sig = fingerprint(args, kwargs)
+        with self._lock:
+            known = sig in self._seen
+            if not known:
+                self.compile_count += 1
+                self._seen[sig] = self.compile_count
+                self._m_compiles.inc()
+            prev, self._last = self._last, sig
+        if known:
+            return False
+        if prev is not None:
+            self.recompile_count += 1
+            self._m_recompiles.inc()
+            self.warn(self._delta_message(prev, sig, args, kwargs))
+        return True
+
+    def _delta_message(self, old: Tuple, new: Tuple, args, kwargs) -> str:
+        old_def, old_leaves = old
+        new_def, new_leaves = new
+        parts: List[str] = []
+        if old_def != new_def:
+            parts.append("pytree structure changed")
+        if len(old_leaves) == len(new_leaves):
+            try:
+                paths = _leaf_paths(args, kwargs)
+            except Exception:
+                paths = [f"leaf[{i}]" for i in range(len(new_leaves))]
+            for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+                if o != n:
+                    parts.append(f"{paths[i]}: {_fmt_leaf_sig(o)} -> "
+                                 f"{_fmt_leaf_sig(n)}")
+        else:  # e.g. a mask appearing mid-run (None -> array)
+            parts.append(f"leaf count {len(old_leaves)} -> "
+                         f"{len(new_leaves)}")
+        delta = "; ".join(parts[:8]) or "signature changed"
+        if len(parts) > 8:
+            delta += f"; … {len(parts) - 8} more"
+        return (f"recompile #{self.compile_count} of {self.name}: {delta} "
+                f"(each new signature costs an XLA compilation; pad/bucket "
+                f"inputs to stable shapes to avoid this)")
+
+
+class _InstrumentedJit:
+    """Transparent wrapper: ``__call__`` runs the detector then the jitted
+    fn; everything else (``lower``, ``trace``, ``clear_cache``…) delegates,
+    so AOT-compile workflows (bench.py) keep working on the wrapped
+    object.
+
+    ``argnums`` restricts the fingerprint to those positional args — the
+    fit loops pass only the DATA argument positions (batch, labels, masks,
+    carries), because the params/optimizer-state pytrees cannot change
+    abstract shape between steps (each step's inputs are the previous
+    step's outputs) and fingerprinting hundreds of param leaves every
+    iteration is measurable host overhead."""
+
+    __slots__ = ("_fn", "detector", "_argnums")
+
+    def __init__(self, fn: Callable, detector: RecompileDetector,
+                 argnums: Optional[Tuple[int, ...]] = None):
+        self._fn = fn
+        self.detector = detector
+        self._argnums = argnums
+
+    def __call__(self, *args, **kwargs):
+        if self._argnums is None:
+            self.detector.check(args, kwargs)
+        else:
+            # dict keyed by the ORIGINAL position so delta paths stay
+            # meaningful ("args[4]: f32[32,8] -> f32[20,8]")
+            sel = {i: args[i] for i in self._argnums if i < len(args)}
+            self.detector.check(sel, kwargs)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+    def __repr__(self):
+        return f"InstrumentedJit({self.detector.name})"
+
+
+def instrument(fn: Callable, name: str, registry=None,
+               warn: Optional[Callable[[str], None]] = None,
+               argnums: Optional[Tuple[int, ...]] = None) -> _InstrumentedJit:
+    """Wrap a jitted callable with a RecompileDetector (see module doc).
+    ``argnums``: fingerprint only these positional args (hot-loop cost
+    control; see ``_InstrumentedJit``)."""
+    return _InstrumentedJit(fn, RecompileDetector(name, registry, warn),
+                            None if argnums is None else tuple(argnums))
+
+
+def compile_counter(fn_name: str, registry=None):
+    """The shared ``dl4j_compiles_total{fn=}`` child for callers outside
+    the detector (e.g. bench AOT compiles) — ONE owner for the family
+    declaration, so label sets can never diverge."""
+    from deeplearning4j_tpu.observability.metrics import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    return reg.counter(
+        _COMPILES, "Distinct abstract input signatures (≈ XLA "
+        "compilations) per jitted function", labels=("fn",)).labels(
+        fn=fn_name)

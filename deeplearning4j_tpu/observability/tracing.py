@@ -1,0 +1,191 @@
+"""Lightweight span tracer: context-manager API, monotonic clocks,
+parent/child nesting, JSON-lines export.
+
+Spans are host-side wall-time markers around *dispatch* (on TPU the device
+work is async — a span brackets what the host did, which is exactly the
+phase-attribution SparkNet/DeepSpark-style throughput tuning needs).  For
+*device* time, enable the optional jax-profiler passthrough: with
+``use_jax_profiler=True`` every span also enters a
+``jax.profiler.TraceAnnotation`` so spans line up with XLA ops in the
+TensorBoard profile, and ``SpanTracer.profile(log_dir)`` brackets a whole
+region with ``jax.profiler.start_trace``/``stop_trace``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional
+
+
+class Span:
+    """One finished (or in-flight) span."""
+
+    __slots__ = ("name", "span_id", "parent_id", "start_ns", "end_ns",
+                 "attrs")
+
+    def __init__(self, name: str, span_id: int, parent_id: Optional[int],
+                 start_ns: int, attrs: Dict[str, Any]):
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.start_ns = start_ns
+        self.end_ns: Optional[int] = None
+        self.attrs = attrs
+
+    @property
+    def duration_ns(self) -> Optional[int]:
+        if self.end_ns is None:
+            return None
+        return self.end_ns - self.start_ns
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        d = self.duration_ns
+        return None if d is None else d / 1e6
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "duration_ns": self.duration_ns,
+            "attrs": self.attrs,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Span":
+        s = Span(d["name"], d["span_id"], d.get("parent_id"),
+                 d["start_ns"], d.get("attrs") or {})
+        s.end_ns = d.get("end_ns")
+        return s
+
+
+class SpanTracer:
+    """Nesting tracer with a bounded in-memory buffer of finished spans.
+
+    Per-thread parent tracking (a serving handler thread and the training
+    loop can both trace without cross-linking), monotonic
+    ``perf_counter_ns`` clocks, O(1) memory via a ``deque(maxlen=...)``.
+    """
+
+    def __init__(self, max_spans: int = 4096,
+                 use_jax_profiler: bool = False):
+        self.max_spans = max_spans
+        self.use_jax_profiler = use_jax_profiler
+        self._ids = itertools.count(1)
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+        self._finished: deque = deque(maxlen=max_spans)
+        self.dropped = 0  # finished spans evicted by the bound
+
+    def _stack(self) -> List[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    @contextmanager
+    def span(self, name: str, **attrs) -> Iterator[Span]:
+        stack = self._stack()
+        parent = stack[-1].span_id if stack else None
+        s = Span(name, next(self._ids), parent, time.perf_counter_ns(), attrs)
+        stack.append(s)
+        annot = None
+        if self.use_jax_profiler:
+            try:
+                import jax
+
+                annot = jax.profiler.TraceAnnotation(name)
+                annot.__enter__()
+            except Exception:
+                annot = None
+        try:
+            yield s
+        finally:
+            if annot is not None:
+                annot.__exit__(None, None, None)
+            s.end_ns = time.perf_counter_ns()
+            stack.pop()
+            with self._lock:
+                if len(self._finished) == self._finished.maxlen:
+                    self.dropped += 1
+                self._finished.append(s)
+
+    @contextmanager
+    def profile(self, log_dir: str) -> Iterator[None]:
+        """Bracket a region with a jax profiler trace (XPlane/TensorBoard);
+        no-ops if the profiler is unavailable."""
+        started = False
+        try:
+            import jax
+
+            jax.profiler.start_trace(str(log_dir))
+            started = True
+        except Exception:
+            pass
+        try:
+            yield
+        finally:
+            if started:
+                import jax
+
+                jax.profiler.stop_trace()
+
+    # ------------------------------------------------------------- export
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._finished)
+
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(s.to_dict()) for s in self.spans())
+
+    def export_jsonl(self, path: str) -> int:
+        """Write finished spans as JSON lines; returns the span count."""
+        spans = self.spans()
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(s.to_dict()) + "\n")
+        return len(spans)
+
+    @staticmethod
+    def read_jsonl(path: str) -> List[Span]:
+        out = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(Span.from_dict(json.loads(line)))
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()
+            self.dropped = 0
+
+
+_global_lock = threading.Lock()
+_global_tracer: Optional[SpanTracer] = None
+
+
+def get_tracer() -> SpanTracer:
+    """The process-wide default tracer (created on first use)."""
+    global _global_tracer
+    with _global_lock:
+        if _global_tracer is None:
+            _global_tracer = SpanTracer()
+        return _global_tracer
+
+
+def set_tracer(tracer: Optional[SpanTracer]) -> SpanTracer:
+    """Swap the process-wide tracer (tests / profiling runs)."""
+    global _global_tracer
+    with _global_lock:
+        _global_tracer = tracer or SpanTracer()
+        return _global_tracer

@@ -29,7 +29,10 @@ class ScoreIterationListener(IterationListener):
 
     def iteration_done(self, model, iteration):
         if iteration % self.freq == 0:
-            self.log(f"Score at iteration {iteration} is {model.score_value}")
+            score = getattr(model, "score_value", None)
+            if score is None:  # models without a score surface (e.g. raw
+                score = float("nan")  # pretrain wrappers) must not crash
+            self.log(f"Score at iteration {iteration} is {score}")
 
 
 class PerformanceListener(IterationListener):
@@ -44,6 +47,9 @@ class PerformanceListener(IterationListener):
         self._batch_size: Optional[int] = None
 
     def set_batch_size(self, n: int):
+        """Called automatically by the fit loops with the actual minibatch
+        size (``models.common.notify_listeners``); manual calls still work
+        for custom training loops."""
         self._batch_size = n
 
     def iteration_done(self, model, iteration):
@@ -51,8 +57,11 @@ class PerformanceListener(IterationListener):
         if self._last_time is not None:
             dt = now - self._last_time
             self.last_iteration_ms = dt * 1e3
-            if self._batch_size:
-                self.last_samples_per_sec = self._batch_size / dt
+            # prefer the explicitly wired batch size; fall back to the fit
+            # loop's last_batch_size mirror so samples/sec always reports
+            bs = self._batch_size or getattr(model, "last_batch_size", None)
+            if bs:
+                self.last_samples_per_sec = bs / dt
             if iteration % self.freq == 0:
                 msg = f"iteration {iteration}; iteration time: {self.last_iteration_ms:.2f} ms"
                 if self.last_samples_per_sec:
@@ -74,6 +83,12 @@ class CollectScoresIterationListener(IterationListener):
 class ComposableIterationListener(IterationListener):
     def __init__(self, *listeners):
         self.listeners = list(listeners)
+
+    def set_batch_size(self, n: int):
+        for l in self.listeners:
+            setter = getattr(l, "set_batch_size", None)
+            if setter is not None:
+                setter(n)
 
     def iteration_done(self, model, iteration):
         for l in self.listeners:

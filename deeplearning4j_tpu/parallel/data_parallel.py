@@ -35,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.observability import PhaseTimers, get_registry, instrument
 from deeplearning4j_tpu.optimize import updaters as upd
 
 
@@ -140,6 +141,9 @@ class ParallelWrapper:
         self.average_updaters = average_updaters
         self._step_fn = None
         self.iteration = 0
+        # wait≙time blocked on window assembly (host ETL), dispatch≙the
+        # vmapped train window + averaging all-reduce
+        self._phases = PhaseTimers("parallel_wrapper")
 
     # -- sharding specs ----------------------------------------------------
     def _replica_sharding(self):
@@ -196,7 +200,9 @@ class ParallelWrapper:
                 )
             return params_k, upd_k, ns_k, losses
 
-        self._step_fn = jax.jit(fit_window, donate_argnums=(0, 1, 2))
+        self._step_fn = instrument(
+            jax.jit(fit_window, donate_argnums=(0, 1, 2)),
+            "ParallelWrapper.fit_window", argnums=(3, 4, 5, 6, 7, 8))
 
     # -- fit ---------------------------------------------------------------
     def fit(self, iterator):
@@ -236,16 +242,28 @@ class ParallelWrapper:
             windows = _WindowAssembler(iterator, K, F, self._stack_window,
                                        prefetch=self.prefetch_size)
 
+        get_registry().gauge(
+            "dl4j_parallel_replicas",
+            "Data-parallel replica count of the active ParallelWrapper",
+        ).set(K)
         it = net.iteration
         last_losses = None
-        for xs, ys, fms, lms, n_batches in windows:
-            rngs = jax.random.split(self.net._keys.next(),
-                                    xs.shape[0] * K).reshape(xs.shape[0], K)
-            params_k, upd_k, ns_k, last_losses = self._step_fn(
-                params_k, upd_k, ns_k, jnp.asarray(float(it)),
-                jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
-            )
+        win_iter = iter(windows)
+        while True:
+            with self._phases.phase("wait_window"):
+                win = next(win_iter, None)
+            if win is None:
+                break
+            xs, ys, fms, lms, n_batches = win
+            with self._phases.phase("dispatch"):
+                rngs = jax.random.split(self.net._keys.next(),
+                                        xs.shape[0] * K).reshape(xs.shape[0], K)
+                params_k, upd_k, ns_k, last_losses = self._step_fn(
+                    params_k, upd_k, ns_k, jnp.asarray(float(it)),
+                    jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
+                )
             it += n_batches // K
+            self._phases.steps += 1
 
         # fold averaged replica-0 state back into the facade
         net.params = jax.tree_util.tree_map(lambda a: a[0], params_k)
@@ -256,6 +274,11 @@ class ParallelWrapper:
         self.iteration = it - net.iteration
         net.iteration = it
         return net
+
+    def phase_stats(self):
+        """Per-phase wall-time aggregates of this wrapper's fit loop
+        (same schema as ``TrainingMaster.training_stats()['phases']``)."""
+        return self._phases.as_dict()
 
     def _stack_window(self, window):
         """Host half of a window step: pad + stack to [F, K, B, ...].

@@ -63,8 +63,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.backend.compat import pcast, shard_map
 
+from deeplearning4j_tpu.models.common import notify_listeners
+from deeplearning4j_tpu.observability import PhaseTimers, instrument
 from deeplearning4j_tpu.optimize import updaters as upd
 from deeplearning4j_tpu.parallel.training_master import TrainingMaster
 
@@ -219,6 +221,16 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         # pass, trading ~1 extra forward for O(1) residuals per tick.
         self.remat = remat
         self._built = False
+        # registry-backed phase timers: whole-step dispatch on the compiled
+        # paths; per-stage forward/backward dispatch on the orchestrated one
+        self._phases = PhaseTimers("pipeline_master")
+
+    def training_stats(self) -> Dict[str, Any]:
+        """Phase-timed stats: whole-step ``dispatch`` on the compiled paths,
+        ``stage{s}_fwd``/``stage{s}_bwd`` dispatch on the orchestrated one
+        (same schema as the other masters; also in the registry as
+        ``dl4j_phase_seconds{component="pipeline_master"}``)."""
+        return self._phases.as_dict()
 
     def bubble_fraction(self) -> float:
         """Analytic pipeline bubble: of the M + S - 1 schedule ticks, S - 1
@@ -538,9 +550,9 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                 return br
 
             branches = [make_branch(s) for s in range(S)]
-            state0 = lax.pcast(jnp.zeros((buf,), buf_dtype), ("pipe",),
+            state0 = pcast(jnp.zeros((buf,), buf_dtype), ("pipe",),
                                to="varying")
-            loss0 = lax.pcast(jnp.zeros(()), ("pipe",), to="varying")
+            loss0 = pcast(jnp.zeros(()), ("pipe",), to="varying")
 
             def run_tick(state, t):
                 return lax.switch(idx, branches, state, t)
@@ -602,7 +614,8 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             }
             return new_tree, new_opt, loss + reg_val
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return instrument(jax.jit(step, donate_argnums=(0, 1)),
+                          "PipelineParallelTrainingMaster.hetero_step", argnums=(2, 3, 4))
 
     def _finish_hetero_sharded_step(self, schedule_loss, cfg, S):
         """Sharded-param variant: each device owns one [Pmax] f32 row
@@ -654,7 +667,8 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                 params={"_pipe": {"w": flat}})
             return flat - updates["_pipe"]["w"], new_opt, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return instrument(jax.jit(step, donate_argnums=(0, 1)),
+                          "PipelineParallelTrainingMaster.hetero_step", argnums=(2, 3, 4))
 
     def _execute_hetero(self, net, iterator):
         M = self.n_microbatches
@@ -687,12 +701,13 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             if key not in self._compiled_steps:
                 self._compiled_steps[key] = self._make_hetero_step(
                     net, xs.shape[1:], xs.dtype)
-            tree, opt_state, loss = self._compiled_steps[key](
-                tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
+            with self._phases.phase("dispatch"):
+                tree, opt_state, loss = self._compiled_steps[key](
+                    tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
             net.score_value = loss
             net.iteration += 1
-            for lst in net.listeners:
-                lst.iteration_done(net, net.iteration)
+            self._phases.steps += 1
+            notify_listeners(net, len(x))
         if self._hetero_sharded:
             net.params.update(self._hetero_unflatten_host(tree))
             for k in net.updater_state:
@@ -770,7 +785,7 @@ class PipelineParallelTrainingMaster(TrainingMaster):
 
             def local_loss(pfx_p, blk_local, sfx_p):
                 state0 = jnp.zeros(probe.shape, probe.dtype)
-                state0 = lax.pcast(state0, ("pipe",), to="varying")
+                state0 = pcast(state0, ("pipe",), to="varying")
 
                 def run_tick(state, t):
                     a0 = prefix_fwd(pfx_p, xs[jnp.clip(t, 0, M - 1)])
@@ -793,7 +808,7 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                     state = lax.ppermute(outv, "pipe", perm)
                     return (state, loss_sum), None
 
-                loss0 = lax.pcast(jnp.zeros(()), ("pipe",), to="varying")
+                loss0 = pcast(jnp.zeros(()), ("pipe",), to="varying")
                 (_, loss_sum), _ = lax.scan(
                     tick, (state0, loss0), jnp.arange(M + S - 1))
                 # LOCAL loss only (nonzero on the last stage).  Differentiating
@@ -845,7 +860,8 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             }
             return new_tree, new_opt, loss + reg_val
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        return instrument(jax.jit(step, donate_argnums=(0, 1)),
+                          "PipelineParallelTrainingMaster.compiled_step", argnums=(2, 3, 4))
 
     def _execute_compiled(self, net, iterator):
         M = self.n_microbatches
@@ -872,12 +888,13 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             if key not in self._compiled_steps:
                 self._compiled_steps[key] = self._make_compiled_step(
                     net, xs.shape[1:], xs.dtype)
-            tree, opt_state, loss = self._compiled_steps[key](
-                tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
+            with self._phases.phase("dispatch"):
+                tree, opt_state, loss = self._compiled_steps[key](
+                    tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
-            for lst in net.listeners:
-                lst.iteration_done(net, net.iteration)
+            self._phases.steps += 1
+            notify_listeners(net, len(x))
         net.params.update(self._unstack_tree(tree))
         for slot, t in opt_state.items():
             net.updater_state[slot].update(self._unstack_tree(t))
@@ -909,8 +926,8 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             loss = self._train_batch(net, ds, stage_params, stage_upd)
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
-            for lst in net.listeners:
-                lst.iteration_done(net, net.iteration)
+            self._phases.steps += 1
+            notify_listeners(net, len(ds))
         # merge stage params back
         for s in range(S):
             for name, p in stage_params[s].items():
@@ -941,26 +958,32 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         grads = [None] * S
 
         def forward(m):
-            # async dispatch overlaps (m, s) with (m+1, s-1)
+            # async dispatch overlaps (m, s) with (m+1, s-1); the per-stage
+            # timers measure host DISPATCH time per stage (device compute is
+            # async), which is what serializes the orchestrated schedule
             a = jax.device_put(xs[m], self.devices[0])
             for s in range(S - 1):
-                a, vjp = jax.vjp(self._stage_fwds[s], stage_params[s], a)
+                with self._phases.phase(f"stage{s}_fwd"):
+                    a, vjp = jax.vjp(self._stage_fwds[s], stage_params[s], a)
                 pullbacks[m][s] = vjp
                 a = jax.device_put(a, self.devices[s + 1])
             y_m = jax.device_put(ys[m], self.devices[S - 1])
-            loss_m, vjp = jax.vjp(self._last_stage, stage_params[S - 1], a,
-                                  y_m)
+            with self._phases.phase(f"stage{S - 1}_fwd"):
+                loss_m, vjp = jax.vjp(self._last_stage, stage_params[S - 1],
+                                      a, y_m)
             pullbacks[m][S - 1] = vjp
             losses[m] = loss_m
 
         def backward(m):
             seed = jnp.ones((), losses[m].dtype) / M
-            gp, ga, _gy = pullbacks[m][S - 1](seed)
+            with self._phases.phase(f"stage{S - 1}_bwd"):
+                gp, ga, _gy = pullbacks[m][S - 1](seed)
             grads[S - 1] = gp if grads[S - 1] is None else jax.tree_util.tree_map(
                 jnp.add, grads[S - 1], gp)
             for s in range(S - 2, -1, -1):
                 ga = jax.device_put(ga, self.devices[s])
-                gp, ga = pullbacks[m][s](ga)
+                with self._phases.phase(f"stage{s}_bwd"):
+                    gp, ga = pullbacks[m][s](ga)
                 grads[s] = gp if grads[s] is None else jax.tree_util.tree_map(
                     jnp.add, grads[s], gp)
             pullbacks[m] = [None] * S   # release stashed activations

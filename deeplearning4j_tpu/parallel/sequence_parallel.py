@@ -35,7 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from deeplearning4j_tpu.backend.compat import pcast, shard_map
 
 from deeplearning4j_tpu.backend import device as backend
 from deeplearning4j_tpu.optimize import updaters as upd
@@ -72,9 +72,9 @@ def ring_attention(q, k, v, mask=None, *, axis_name: str,
     # over the ring axis so the scan carry typechecks under shard_map
     acc = jnp.promote_types(q.dtype, jnp.float32)
     qf = q.astype(acc)
-    o0 = lax.pcast(jnp.zeros((b, h, t_local, d), acc), (axis_name,), to="varying")
-    l0 = lax.pcast(jnp.zeros((b, h, t_local), acc), (axis_name,), to="varying")
-    m0 = lax.pcast(jnp.full((b, h, t_local), _NEG, acc), (axis_name,), to="varying")
+    o0 = pcast(jnp.zeros((b, h, t_local, d), acc), (axis_name,), to="varying")
+    l0 = pcast(jnp.zeros((b, h, t_local), acc), (axis_name,), to="varying")
+    m0 = pcast(jnp.full((b, h, t_local), _NEG, acc), (axis_name,), to="varying")
     scale = jnp.asarray(1.0 / np.sqrt(d), acc)
     perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
 

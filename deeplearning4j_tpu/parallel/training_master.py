@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.observability import PhaseTimers, instrument
 from deeplearning4j_tpu.optimize import updaters as upd
 
 
@@ -49,69 +50,20 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(coordinator_address, num_processes, process_id)
 
 
-class PhaseStats:
+class PhaseStats(PhaseTimers):
     """Phase-timed distributed training stats (≙ ``CommonSparkTrainingStats
-    .java`` / ``ParameterAveragingTrainingMasterStats.java``: the reference
-    times count/split/repartition/mapPartitions/aggregate per fit; the
-    TPU-native phases are the analogous pipeline sections)."""
+    .java`` / ``ParameterAveragingTrainingMasterStats.java``).
 
-    _NULL = None  # no-op timer singleton (enabled=False)
+    Since the unified-telemetry refactor this is a thin alias over
+    ``observability.PhaseTimers``: the ``phase()`` / ``steps`` /
+    ``as_dict()`` surface is unchanged, but every timed phase ALSO lands in
+    the process-wide metrics registry as
+    ``dl4j_phase_seconds{component=..., phase=...}`` so /metrics scrapes
+    and bench snapshots see it (migration notes: docs/observability.md)."""
 
-    def __init__(self, enabled: bool = True):
-        self.enabled = enabled
-        self.steps = 0
-        # running aggregates only — O(1) memory however long training runs
-        self._agg: Dict[str, list] = {}  # name -> [count, total, min, max]
-
-    class _Timer:
-        def __init__(self, stats, name):
-            self._stats, self._name = stats, name
-
-        def __enter__(self):
-            import time
-
-            self._t0 = time.perf_counter()
-            return self
-
-        def __exit__(self, *exc):
-            import time
-
-            ms = (time.perf_counter() - self._t0) * 1e3
-            agg = self._stats._agg.get(self._name)
-            if agg is None:
-                self._stats._agg[self._name] = [1, ms, ms, ms]
-            else:
-                agg[0] += 1
-                agg[1] += ms
-                agg[2] = min(agg[2], ms)
-                agg[3] = max(agg[3], ms)
-            return False
-
-    class _NullTimer:
-        def __enter__(self):
-            return self
-
-        def __exit__(self, *exc):
-            return False
-
-    def phase(self, name: str):
-        if not self.enabled:
-            if PhaseStats._NULL is None:
-                PhaseStats._NULL = PhaseStats._NullTimer()
-            return PhaseStats._NULL
-        return PhaseStats._Timer(self, name)
-
-    def as_dict(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {"steps": self.steps, "phases": {}}
-        for name, (count, total, mn, mx) in self._agg.items():
-            out["phases"][name] = {
-                "count": count,
-                "total_ms": round(total, 3),
-                "mean_ms": round(total / count, 3),
-                "min_ms": round(mn, 3),
-                "max_ms": round(mx, 3),
-            }
-        return out
+    def __init__(self, enabled: bool = True,
+                 component: str = "training_master"):
+        super().__init__(component, enabled=enabled)
 
 
 class TrainingMaster:
@@ -143,8 +95,12 @@ class SyncTrainingMaster(TrainingMaster):
         self._stats: Dict[str, Any] = {
             "steps": 0, "step_time_ms": collections.deque(maxlen=1024)}
         # per-step phase timers only when stats collection is requested —
-        # the default hot loop stays timer-free
-        self._phases = PhaseStats(enabled=collect_stats)
+        # the default hot loop stays timer-free.  Phase mapping vs the
+        # reference: fetch≙split/repartition, place≙broadcast, dispatch =
+        # gradient compute + the in-graph all-reduce (the reference's
+        # aggregate), device_sync = host sync on the step result.
+        self._phases = PhaseStats(enabled=collect_stats,
+                                  component="sync_master")
         self._step = None
 
     def _param_layout(self, net):
@@ -190,12 +146,12 @@ class SyncTrainingMaster(TrainingMaster):
 
         in_shardings = (players, ulayers, repl, repl, data, data, repl, data,
                         data)
-        self._step = jax.jit(
+        self._step = instrument(jax.jit(
             step,
             in_shardings=in_shardings,
             out_shardings=(players, ulayers, repl, repl),
             donate_argnums=(0, 1, 2),
-        )
+        ), f"{type(self).__name__}.step", argnums=(3, 4, 5, 6, 7, 8))
         self._data_sharding = data
         self._repl_sharding = repl
         self._params_layout = players
@@ -205,6 +161,7 @@ class SyncTrainingMaster(TrainingMaster):
         import time
 
         from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
+        from deeplearning4j_tpu.models.common import notify_listeners
 
         if isinstance(iterator, DataSetIterator) and iterator.async_supported():
             iterator = AsyncDataSetIterator(iterator, self.prefetch_size)
@@ -224,6 +181,7 @@ class SyncTrainingMaster(TrainingMaster):
                     ds = next(it)
                 except StopIteration:
                     break
+            n_real = len(ds)
             if len(ds) % K:
                 ds = ds.pad_batch(((len(ds) + K - 1) // K) * K)
             t0 = time.perf_counter()
@@ -247,8 +205,7 @@ class SyncTrainingMaster(TrainingMaster):
                 self._stats["step_time_ms"].append((time.perf_counter() - t0) * 1e3)
             self._stats["steps"] += 1
             self._phases.steps += 1
-            for lst in net.listeners:
-                lst.iteration_done(net, net.iteration)
+            notify_listeners(net, n_real)
         net.params, net.updater_state, net.net_state = params, upd_state, ns
 
     def training_stats(self):
@@ -280,7 +237,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
         self._stats: Dict[str, Any] = {"windows": 0}
-        self._phases = PhaseStats()
+        self._phases = PhaseStats(component="param_avg_master")
 
     def execute_training(self, net, iterator):
         from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
@@ -19,15 +20,23 @@ from typing import Callable, Optional
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.observability import get_registry
 from deeplearning4j_tpu.streaming.pubsub import MessageBroker
 from deeplearning4j_tpu.streaming.serde import (
     array_to_base64, base64_to_array, record_to_dataset,
 )
 
 
+import itertools
+
+_SERVER_IDS = itertools.count()
+
+
 class InferenceServer:
     """HTTP model server: POST /predict with an NDArray envelope (or a plain
-    JSON list) returns the model's output.  GET /healthz for liveness.
+    JSON list) returns the model's output.  GET /healthz for liveness,
+    GET /metrics for a Prometheus scrape (request counters, latency
+    histograms, queue depth — see docs/observability.md).
 
     Requests that arrive concurrently are micro-batched: the handler thread
     enqueues, a single dispatch thread pads the queue contents to
@@ -36,7 +45,7 @@ class InferenceServer:
     """
 
     def __init__(self, model, max_batch: int = 32,
-                 max_wait_ms: float = 2.0, port: int = 0):
+                 max_wait_ms: float = 2.0, port: int = 0, registry=None):
         self.model = model
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
@@ -45,6 +54,44 @@ class InferenceServer:
         self._pending: list = []
         self._lock = threading.Condition()
         self._stop = False
+        # serving telemetry: scraped live from GET /metrics (Prometheus
+        # text format) on this server's own port.  Counters/histograms are
+        # additive across instances (unlabeled singletons aggregate
+        # naturally); the PER-INSTANCE gauges (queue depth callback, config)
+        # are labeled by a process-unique server id so a second server
+        # neither clobbers the first's callback nor zeroes it on stop().
+        self.registry = registry if registry is not None else get_registry()
+        self.server_id = f"s{next(_SERVER_IDS)}"
+        self._m_requests = self.registry.counter(
+            "dl4j_serving_requests_total",
+            "Predict requests by outcome", labels=("status",))
+        self._m_latency = self.registry.histogram(
+            "dl4j_serving_request_seconds",
+            "End-to-end predict latency (enqueue -> response ready, "
+            "including micro-batching wait)")
+        self._m_rows = self.registry.histogram(
+            "dl4j_serving_request_rows",
+            "Rows per predict request",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        self._m_batch_rows = self.registry.histogram(
+            "dl4j_serving_batch_rows",
+            "Rows per dispatched micro-batch (padding excluded)",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
+        # weakref: the registry outlives the server — a strong closure
+        # would pin the server (and its model) for process lifetime
+        import weakref
+
+        ref = weakref.ref(self)
+        self._m_queue = self.registry.gauge(
+            "dl4j_serving_queue_depth",
+            "Requests waiting for the micro-batch dispatcher",
+            labels=("server",)).labels(server=self.server_id)
+        self._m_queue.set_function(
+            lambda: len(s._pending) if (s := ref()) is not None else 0.0)
+        self.registry.gauge(
+            "dl4j_serving_max_batch",
+            "Configured micro-batch row budget",
+            labels=("server",)).set(max_batch, server=self.server_id)
 
     # --------------------------------------------------------- micro-batcher
     def _run_model(self, feats: np.ndarray) -> np.ndarray:
@@ -84,7 +131,9 @@ class InferenceServer:
                     batch.append(req)
                     rows += len(req[0])
             try:
-                out = self._run_model(np.concatenate([b[0] for b in batch]))
+                feats = np.concatenate([b[0] for b in batch])
+                self._m_batch_rows.observe(len(feats))
+                out = self._run_model(feats)
                 pos = 0
                 for f, done, result in batch:
                     result.append(out[pos:pos + len(f)])
@@ -101,14 +150,19 @@ class InferenceServer:
         features = np.asarray(features, np.float32)
         if features.ndim == 1:
             features = features[None, :]
+        t0 = time.perf_counter()
         done = threading.Event()
         result: list = []
         with self._lock:
             self._pending.append((features, done, result))
             self._lock.notify_all()
         done.wait()
+        self._m_latency.observe(time.perf_counter() - t0)
+        self._m_rows.observe(len(features))
         if isinstance(result[0], Exception):
+            self._m_requests.inc(status="error")
             raise result[0]
+        self._m_requests.inc(status="ok")
         return result[0]
 
     # ------------------------------------------------------------- lifecycle
@@ -130,6 +184,18 @@ class InferenceServer:
             def do_GET(self):
                 if self.path == "/healthz":
                     self._json({"status": "ok"})
+                elif self.path == "/metrics":
+                    # Prometheus text exposition of the server's registry
+                    # (serving metrics + whatever else the process records:
+                    # fit metrics, compile counts, device memory…)
+                    body = server.registry.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self.send_error(404)
 
@@ -165,6 +231,9 @@ class InferenceServer:
         with self._lock:
             self._stop = True
             self._lock.notify_all()
+        # freeze THIS server's queue gauge (per-instance labeled child —
+        # other servers' callbacks are untouched)
+        self._m_queue.set(0.0)
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

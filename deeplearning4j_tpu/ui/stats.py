@@ -25,6 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.observability.memory import (
+    device_memory_stats,  # re-exported: ui.device_memory_stats is public API
+    sample_once as _sample_device_memory,
+)
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 
 
@@ -117,34 +121,39 @@ def _tensor_stats(tree, bins: int) -> Dict[str, Any]:
     return out
 
 
-def device_memory_stats() -> Dict[str, Any]:
-    """PJRT per-device memory (≙ JVM memory MX beans in the reference)."""
-    out = {}
-    for i, d in enumerate(jax.local_devices()):
-        try:
-            ms = d.memory_stats()
-        except Exception:
-            ms = None
-        if ms:
-            out[f"device_{i}"] = {
-                "bytes_in_use": ms.get("bytes_in_use"),
-                "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
-                "bytes_limit": ms.get("bytes_limit"),
-            }
-    return out
+# device_memory_stats moved to observability.memory (PJRT per-device memory,
+# ≙ JVM memory MX beans in the reference); imported above for back-compat.
 
 
 class StatsListener(IterationListener):
     """Collects per-iteration stats into a StatsStorage router.
-    ≙ ``StatsListener.java``."""
+    ≙ ``StatsListener.java``.
+
+    Timing/throughput come from the shared metrics registry (the fit loops
+    record ``dl4j_fit_last_step_seconds`` / ``dl4j_fit_samples_per_second``
+    around the actual step dispatch) instead of re-deriving them from this
+    listener's own wall clock; the clock remains as a fallback for custom
+    training loops that bypass the instrumented facades."""
 
     def __init__(self, storage, session_id: Optional[str] = None,
-                 config: Optional[StatsUpdateConfiguration] = None):
+                 config: Optional[StatsUpdateConfiguration] = None,
+                 registry=None):
         self.storage = storage
         self.session_id = session_id or f"session_{int(time.time() * 1000)}"
         self.config = config or StatsUpdateConfiguration()
+        self.registry = registry
         self._last_time: Optional[float] = None
         self._initialized = False
+
+    def _registry_timing(self, model):
+        """(step_seconds, samples_per_sec) for THIS model, or Nones.
+
+        The fit loops stamp ``last_step_seconds`` / ``last_samples_per_
+        second`` on the model instance (identity-correct even with several
+        same-class models in one process); the kind-labeled registry gauges
+        are NOT used here precisely because they would cross-contaminate."""
+        return (getattr(model, "last_step_seconds", None),
+                getattr(model, "last_samples_per_second", None))
 
     def _init_report(self, model) -> None:
         rep = StatsInitializationReport(
@@ -177,9 +186,14 @@ class StatsListener(IterationListener):
         if cfg.collect_score:
             rep.score = float(getattr(model, "score_value", float("nan")))
         if cfg.collect_timing:
-            rep.iteration_time_ms = dt_ms
+            step_s, sps = self._registry_timing(model)
+            rep.iteration_time_ms = (step_s * 1e3 if step_s else dt_ms)
+            if sps:
+                rep.samples_per_second = sps
         if cfg.collect_memory:
-            rep.memory = device_memory_stats()
+            # one shared sample: the report embeds it AND the registry
+            # gauges (dl4j_device_memory_bytes) pick it up
+            rep.memory = _sample_device_memory(self.registry)
         if cfg.collect_histograms_params and getattr(model, "params", None):
             rep.param_histograms = _tensor_stats(model.params,
                                                  cfg.num_histogram_bins)

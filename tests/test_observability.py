@@ -1,0 +1,492 @@
+"""Unified telemetry core: registry semantics, Prometheus rendering, span
+nesting + JSONL round-trip, recompile detection, device-memory gauges,
+serving /metrics, and the fit-loop smoke contract (tier-1: a fit must
+record nonzero step-time metrics)."""
+
+import json
+import math
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.layers.dense import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observability import (
+    DeviceMemoryMonitor, MetricsRegistry, SpanTracer, fingerprint,
+    get_registry, instrument, sample_once, set_registry,
+)
+from deeplearning4j_tpu.observability.phases import PhaseTimers
+from deeplearning4j_tpu.observability.recompile import RecompileDetector
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Isolate each test's metrics; restore the shared registry after."""
+    old = get_registry()
+    reg = set_registry(MetricsRegistry())
+    yield reg
+    set_registry(old)
+
+
+def make_net(seed=7):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=0.1).list()
+         .layer(DenseLayer(n_in=8, n_out=16))
+         .layer(OutputLayer(n_in=16, n_out=4)).build())).init()
+
+
+def make_data(n=32, rs=None):
+    rs = rs or np.random.RandomState(0)
+    x = rs.rand(n, 8).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rs.randint(0, 4, n)]
+    return x, y
+
+
+# ------------------------------------------------------------- registry
+
+def test_counter_semantics(fresh_registry):
+    c = fresh_registry.counter("t_total", "help here")
+    c.inc()
+    c.inc(2.5)
+    assert fresh_registry.get_value("t_total") == 3.5
+    with pytest.raises(ValueError):
+        c.labels().inc(-1)
+
+
+def test_labeled_children_are_independent(fresh_registry):
+    fam = fresh_registry.counter("req_total", labels=("status",))
+    fam.inc(status="ok")
+    fam.inc(status="ok")
+    fam.inc(status="error")
+    assert fam.labels(status="ok").value == 2
+    assert fam.labels(status="error").value == 1
+    with pytest.raises(ValueError):
+        fam.labels(wrong="x")
+
+
+def test_gauge_set_function_and_lazy_value(fresh_registry):
+    g = fresh_registry.gauge("queue_depth")
+    items = [1, 2, 3]
+    g.set_function(lambda: len(items))
+    assert fresh_registry.get_value("queue_depth") == 3
+    items.pop()
+    assert fresh_registry.get_value("queue_depth") == 2
+    # lazy device scalar: float() deferred to read
+    import jax.numpy as jnp
+
+    g2 = fresh_registry.gauge("lazy_score")
+    g2.set(jnp.asarray(1.5))
+    assert fresh_registry.get_value("lazy_score") == 1.5
+
+
+def test_histogram_semantics(fresh_registry):
+    h = fresh_registry.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0)
+                                 ).labels()
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(56.05)
+    assert h.min == pytest.approx(0.05)
+    assert h.max == pytest.approx(50.0)
+    cum = dict(h.cumulative_buckets())
+    assert cum[0.1] == 1 and cum[1.0] == 3 and cum[10.0] == 4
+    assert cum[math.inf] == 5
+
+
+def test_reregistration_is_idempotent_and_kind_checked(fresh_registry):
+    a = fresh_registry.counter("same_name")
+    b = fresh_registry.counter("same_name")
+    assert a is b
+    with pytest.raises(ValueError):
+        fresh_registry.gauge("same_name")
+    with pytest.raises(ValueError):
+        fresh_registry.counter("same_name", labels=("x",))
+
+
+def test_registry_thread_safety(fresh_registry):
+    c = fresh_registry.counter("contended_total").labels()
+
+    def work():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert c.value == 8000
+
+
+# ----------------------------------------------------------- prometheus
+
+def test_prometheus_rendering(fresh_registry):
+    fresh_registry.counter("c_total", "a counter",
+                           labels=("k",)).inc(2, k='va"l')
+    fresh_registry.gauge("g", "a gauge").set(1.5)
+    h = fresh_registry.histogram("h_seconds", "a histogram",
+                                 buckets=(0.5, 1.0))
+    h.observe(0.25)
+    h.observe(0.75)
+    text = fresh_registry.to_prometheus()
+    assert "# HELP c_total a counter" in text
+    assert "# TYPE c_total counter" in text
+    assert 'c_total{k="va\\"l"} 2' in text
+    assert "g 1.5" in text
+    assert 'h_seconds_bucket{le="0.5"} 1' in text
+    assert 'h_seconds_bucket{le="1"} 2' in text
+    assert 'h_seconds_bucket{le="+Inf"} 2' in text
+    assert "h_seconds_count 2" in text
+    assert "h_seconds_sum 1" in text
+
+
+def test_json_snapshot_round_trips(fresh_registry):
+    fresh_registry.counter("j_total").inc(3)
+    h = fresh_registry.histogram("j_seconds").labels()
+    h.observe(0.01)
+    snap = json.loads(fresh_registry.to_json_str())
+    assert snap["j_total"]["values"][0]["value"] == 3
+    assert snap["j_seconds"]["values"][0]["count"] == 1
+
+
+# -------------------------------------------------------------- tracing
+
+def test_span_nesting_and_jsonl_round_trip(tmp_path):
+    tr = SpanTracer(max_spans=64)
+    with tr.span("outer", kind="test") as outer:
+        with tr.span("inner") as inner:
+            pass
+        with tr.span("inner2"):
+            pass
+    path = str(tmp_path / "spans.jsonl")
+    n = tr.export_jsonl(path)
+    assert n == 3
+    spans = {s.name: s for s in SpanTracer.read_jsonl(path)}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["inner2"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].attrs == {"kind": "test"}
+    # children finish before (and within) the parent: monotonic clocks
+    assert spans["outer"].duration_ns >= spans["inner"].duration_ns
+    assert spans["outer"].start_ns <= spans["inner"].start_ns
+    assert spans["inner"].end_ns <= spans["outer"].end_ns
+
+
+def test_tracer_bounded_buffer():
+    tr = SpanTracer(max_spans=4)
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    assert len(tr.spans()) == 4
+    assert tr.dropped == 6
+    assert [s.name for s in tr.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+# ------------------------------------------------------------ recompile
+
+def test_recompile_detector_fires_once_per_new_signature(fresh_registry):
+    import jax
+    import jax.numpy as jnp
+
+    warns = []
+    fn = instrument(jax.jit(lambda a: a.sum()), "toy.step",
+                    warn=warns.append)
+    a32 = jnp.zeros((32, 8))
+    a20 = jnp.zeros((20, 8))
+    fn(a32)
+    fn(a32)
+    assert fn.detector.compile_count == 1 and warns == []
+    fn(a20)                       # new signature -> exactly one warning
+    assert fn.detector.compile_count == 2 and len(warns) == 1
+    assert "32,8" in warns[0] and "20,8" in warns[0]
+    fn(a20)                       # seen signature -> silent
+    fn(a32)                       # seen signature -> silent
+    assert len(warns) == 1
+    # dtype churn is a recompile too
+    fn(jnp.zeros((32, 8), jnp.bfloat16))
+    assert fn.detector.compile_count == 3 and len(warns) == 2
+    # counters mirrored in the registry
+    assert fresh_registry.get_value("dl4j_compiles_total", fn="toy.step") == 3
+    assert fresh_registry.get_value("dl4j_recompiles_total",
+                                    fn="toy.step") == 2
+
+
+def test_fingerprint_distinguishes_structure():
+    import jax.numpy as jnp
+
+    a = jnp.zeros((4,))
+    assert fingerprint((a,), {}) == fingerprint((a,), {})
+    assert fingerprint((a,), {}) != fingerprint(({"k": a},), {})
+    assert fingerprint((a,), {}) != fingerprint((a.astype(jnp.int32),), {})
+
+
+def test_instrumented_jit_delegates_aot_workflow():
+    import jax
+    import jax.numpy as jnp
+
+    fn = instrument(jax.jit(lambda a: a * 2), "toy.aot")
+    lowered = fn.lower(jnp.zeros((3,)))   # attribute delegation
+    compiled = lowered.compile()
+    np.testing.assert_allclose(np.asarray(compiled(jnp.ones((3,)))), 2.0)
+
+
+# --------------------------------------------------------- phase timers
+
+def test_phase_timers_schema_and_registry(fresh_registry):
+    pt = PhaseTimers("unit_test")
+    for _ in range(3):
+        with pt.phase("work"):
+            pass
+    pt.steps = 3
+    d = pt.as_dict()
+    assert d["steps"] == 3
+    w = d["phases"]["work"]
+    assert w["count"] == 3
+    assert w["total_ms"] >= w["mean_ms"] >= 0.0
+    assert w["max_ms"] >= w["min_ms"]
+    fam = fresh_registry.get("dl4j_phase_seconds")
+    child = fam.get(component="unit_test", phase="work")
+    assert child.count == 3
+    # disabled timers record nothing
+    off = PhaseTimers("off_test", enabled=False)
+    with off.phase("x"):
+        pass
+    assert off.as_dict()["phases"] == {}
+
+
+# -------------------------------------------------------- device memory
+
+def test_device_memory_sampling_graceful(fresh_registry):
+    stats = sample_once(fresh_registry)   # CPU: typically no stats — no-op
+    assert isinstance(stats, dict)
+    fam = fresh_registry.get("dl4j_device_memory_bytes")
+    if stats:
+        assert fam is not None
+        dev, per = next(iter(stats.items()))
+        stat = next(k for k, v in per.items() if v is not None)
+        assert fam.get(device=dev, stat=stat) is not None
+    mon = DeviceMemoryMonitor(interval_s=0.05, registry=fresh_registry)
+    mon.start()
+    import time
+
+    time.sleep(0.15)
+    mon.stop()
+    assert mon.samples >= 1
+
+
+# ---------------------------------------------------- fit loop contract
+
+def test_fit_records_step_metrics_smoke(fresh_registry):
+    """Tier-1 smoke: a fit run must record nonzero step-time metrics,
+    iteration counters, and compile counts (acceptance criteria)."""
+    net = make_net()
+    x, y = make_data(32)
+    for _ in range(3):
+        net.fit(x, y)
+    reg = fresh_registry
+    assert reg.get_value("dl4j_fit_iterations_total",
+                         model="MultiLayerNetwork") == 3
+    hist = reg.get("dl4j_fit_step_seconds").get(model="MultiLayerNetwork")
+    assert hist.count == 3 and hist.sum > 0
+    assert reg.get_value("dl4j_compiles_total",
+                         fn="MultiLayerNetwork.train_step") == 1
+    assert reg.get_value("dl4j_fit_batch_size",
+                         model="MultiLayerNetwork") == 32
+    sps = reg.get_value("dl4j_fit_samples_per_second",
+                        model="MultiLayerNetwork")
+    assert sps and sps > 0
+    text = reg.to_prometheus()
+    assert "dl4j_fit_step_seconds_bucket" in text
+    assert "dl4j_fit_iterations_total" in text
+    assert "dl4j_compiles_total" in text
+
+
+def test_fit_shape_change_warns_exactly_once(fresh_registry):
+    """Acceptance: a batch-shape change mid-run emits ONE warning carrying
+    the old -> new signature."""
+    from deeplearning4j_tpu.observability import recompile as rc
+
+    warns = []
+    orig = rc.logger.warning
+    rc.logger.warning = lambda msg, *a: warns.append(msg % a if a else msg)
+    try:
+        net = make_net()
+        x, y = make_data(32)
+        net.fit(x, y)
+        net.fit(x, y)
+        net.fit(x[:20], y[:20])   # shape change -> one warning
+        net.fit(x[:20], y[:20])   # same shape again -> silent
+    finally:
+        rc.logger.warning = orig
+    mine = [w for w in warns if "MultiLayerNetwork.train_step" in w]
+    assert len(mine) == 1
+    assert "32,8" in mine[0] and "20,8" in mine[0]
+    assert fresh_registry.get_value(
+        "dl4j_recompiles_total", fn="MultiLayerNetwork.train_step") == 1
+
+
+def test_performance_listener_auto_batch_size(fresh_registry):
+    from deeplearning4j_tpu.optimize.listeners import PerformanceListener
+
+    pl = PerformanceListener(frequency=100)
+    net = make_net()
+    x, y = make_data(16)
+    for _ in range(3):
+        net.fit(x, y)
+    # no manual set_batch_size call anywhere: the fit loop wired it
+    assert pl.last_samples_per_sec is None  # not attached yet -> untouched
+    net.set_listeners(pl)
+    for _ in range(3):
+        net.fit(x, y)
+    assert pl.last_samples_per_sec is not None
+    assert pl.last_samples_per_sec > 0
+    assert net.last_batch_size == 16
+
+
+def test_scanned_fit_listener_gets_window_samples(fresh_registry):
+    """Listeners fire once per scanned window, so the wired batch size is
+    the WINDOW's sample count (else samples/sec under-reports by
+    scan_steps) while the telemetry batch-size gauge keeps the per-step
+    minibatch size."""
+    net = make_net()
+    x, y = make_data(16)
+    net.fit_scanned([(x, y)] * 4, scan_steps=4)
+    assert net.last_batch_size == 16 * 4
+    assert fresh_registry.get_value("dl4j_fit_batch_size",
+                                    model="MultiLayerNetwork") == 16
+    assert fresh_registry.get_value("dl4j_fit_iterations_total",
+                                    model="MultiLayerNetwork") == 4
+
+
+def test_stats_timing_is_per_model_instance(fresh_registry):
+    """Fit loops stamp last_step_seconds on the model instance, so two
+    same-class models never read each other's timing."""
+    a, b = make_net(1), make_net(2)
+    x, y = make_data(16)
+    a.fit(x, y)
+    assert getattr(a, "last_step_seconds", None)
+    assert not hasattr(b, "last_step_seconds")
+
+
+def test_score_listener_tolerates_missing_score():
+    from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+    class Bare:
+        pass
+
+    logs = []
+    lst = ScoreIterationListener(print_iterations=1, log=logs.append)
+    lst.iteration_done(Bare(), 1)   # must not raise
+    assert "nan" in logs[0]
+
+
+def test_graph_fit_records_metrics(fresh_registry):
+    from deeplearning4j_tpu.models.graph import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater("sgd", learning_rate=0.1)
+            .graph()
+            .add_inputs("in")
+            .add_layer("d", DenseLayer(n_in=8, n_out=16), "in")
+            .add_layer("out", OutputLayer(n_in=16, n_out=4), "d")
+            .set_outputs("out").build())
+    net = ComputationGraph(conf).init()
+    x, y = make_data(16)
+    net.fit(x, y)
+    assert fresh_registry.get_value("dl4j_fit_iterations_total",
+                                    model="ComputationGraph") == 1
+    hist = fresh_registry.get("dl4j_fit_step_seconds").get(
+        model="ComputationGraph")
+    assert hist.count == 1 and hist.sum > 0
+
+
+def test_sync_master_phases_in_registry(fresh_registry):
+    from deeplearning4j_tpu.backend import device as backend
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.parallel.training_master import (
+        DistributedNetwork, SyncTrainingMaster,
+    )
+
+    net = make_net()
+    x, y = make_data(64, np.random.RandomState(3))
+    master = SyncTrainingMaster(mesh=backend.default_mesh(),
+                                collect_stats=True)
+    DistributedNetwork(net, master).fit(
+        ListDataSetIterator(DataSet(x, y), 16))
+    stats = master.training_stats()
+    assert stats["steps"] == 4
+    assert set(stats["phases"]) >= {"fetch", "place", "dispatch",
+                                    "device_sync"}
+    # the same timings landed in the shared registry
+    fam = fresh_registry.get("dl4j_phase_seconds")
+    assert fam is not None
+    assert fam.get(component="sync_master", phase="dispatch").count >= 4
+    assert fresh_registry.get_value(
+        "dl4j_compiles_total", fn="SyncTrainingMaster.step") == 1
+
+
+# -------------------------------------------------------------- serving
+
+def test_inference_server_metrics_endpoint(fresh_registry):
+    from deeplearning4j_tpu.streaming.serving import InferenceServer
+
+    net = make_net()
+    server = InferenceServer(net, max_batch=8, port=0)
+    port = server.start()
+    try:
+        url = f"http://127.0.0.1:{port}"
+        body = json.dumps(np.random.rand(3, 8).tolist()).encode()
+        req = urllib.request.Request(
+            f"{url}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        with urllib.request.urlopen(f"{url}/metrics", timeout=10) as r:
+            assert r.status == 200
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+        assert ctype.startswith("text/plain")
+        assert 'dl4j_serving_requests_total{status="ok"} 1' in text
+        assert "dl4j_serving_request_seconds_bucket" in text
+        assert "dl4j_serving_queue_depth" in text
+        assert "dl4j_serving_batch_rows" in text
+        # in-process path shares the same counters
+        server.predict(np.random.rand(2, 8).astype(np.float32))
+        assert fresh_registry.get_value("dl4j_serving_requests_total",
+                                        status="ok") == 2
+    finally:
+        server.stop()
+
+
+def test_stats_listener_reads_registry_timing(fresh_registry):
+    from deeplearning4j_tpu.ui.stats import StatsListener, StatsUpdateConfiguration
+
+    class MemStorage:
+        def __init__(self):
+            self.updates = []
+
+        def put_init_report(self, rep):
+            pass
+
+        def put_update(self, rep):
+            self.updates.append(rep)
+
+    storage = MemStorage()
+    net = make_net()
+    net.set_listeners(StatsListener(
+        storage, config=StatsUpdateConfiguration(
+            collect_histograms_params=False, collect_memory=False,
+            collect_mean_magnitudes=False)))
+    x, y = make_data(16)
+    for _ in range(3):
+        net.fit(x, y)
+    assert storage.updates
+    rep = storage.updates[-1]
+    # timing comes from the shared registry (set by the fit loop), so it is
+    # nonzero from the FIRST report (the old clock needed two iterations)
+    assert storage.updates[0].iteration_time_ms > 0
+    assert rep.iteration_time_ms > 0
+    assert rep.samples_per_second > 0
