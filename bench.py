@@ -685,6 +685,117 @@ def bench_long_context(platform, peak):
     }
 
 
+def _drive_serving(engine, n_threads, per_thread, n_in):
+    """Mixed-size concurrent client load against one engine; returns
+    (rows_per_sec, latencies_seconds) — the request mix is deterministic
+    per thread so both variants serve identical traffic."""
+    import threading
+
+    latencies, total_rows, errors = [], [0], []
+    lock = threading.Lock()
+
+    def client(tid):
+        rs = np.random.RandomState(1000 + tid)
+        sizes = 1 + rs.randint(16, size=per_thread)
+        feats = [rs.rand(int(s), n_in).astype(np.float32) for s in sizes]
+        local = []
+        try:
+            for x in feats:
+                t0 = time.perf_counter()
+                engine.predict(x)
+                local.append(time.perf_counter() - t0)
+        except Exception as e:
+            with lock:
+                errors.append(e)
+            return
+        with lock:
+            latencies.extend(local)
+            total_rows[0] += int(sizes.sum())
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(n_threads)]
+    t0 = time.perf_counter()
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    wall = time.perf_counter() - t0
+    if errors:
+        # a partial run would publish silently skewed numbers
+        raise RuntimeError(
+            f"serving bench: {len(errors)}/{n_threads} client threads "
+            f"failed; first: {errors[0]!r}")
+    return total_rows[0] / wall, latencies
+
+
+def bench_serving(platform, peak):
+    """Serving engine throughput/latency under concurrent mixed-size load:
+    the shape-bucketed dynamic batcher vs the legacy pad-everything-to-
+    ``max_batch`` path (expressed as a single-bucket policy).  Also proves
+    the AOT-warmup contract on record: steady-state traffic after warmup
+    must trigger zero XLA compiles."""
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.observability import get_registry
+    from deeplearning4j_tpu.serving import BucketPolicy, ServingEngine
+
+    n_in, hidden, n_out, max_batch = 64, 256, 10, 64
+    n_threads, per_thread = (8, 40) if platform == "tpu" else (8, 15)
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(12345)
+                .updater("sgd", learning_rate=0.1).list()
+                .layer(DenseLayer(n_in=n_in, n_out=hidden, activation="relu"))
+                .layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+                .layer(OutputLayer(n_in=hidden, n_out=n_out, loss="mcxent",
+                                   activation="softmax"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    variants = {}
+    steady_state_compiles = None
+    for name, policy in (
+            ("bucketed", BucketPolicy(max_batch=max_batch)),
+            ("fixed_max_batch", BucketPolicy(max_batch=max_batch,
+                                             batch_buckets=(max_batch,)))):
+        engine = ServingEngine(build_net(), policy=policy, max_wait_ms=1.0,
+                               max_queue=4096,
+                               example=np.zeros((n_in,), np.float32))
+        engine.start()   # AOT warmup of every bucket shape
+        compiles_warm = get_registry().get_value("dl4j_compiles_total",
+                                                 fn="serving.default")
+        rows_per_sec, lats = _drive_serving(engine, n_threads, per_thread,
+                                            n_in)
+        compiles_after = get_registry().get_value("dl4j_compiles_total",
+                                                  fn="serving.default")
+        engine.stop()
+        if name == "bucketed":
+            steady_state_compiles = compiles_after - compiles_warm
+        variants[name] = {
+            "rows_per_sec": round(rows_per_sec, 1),
+            "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3),
+            "requests": len(lats),
+            "warmup_shapes": len(policy.batch_buckets),
+            "compiles_during_traffic": compiles_after - compiles_warm,
+        }
+    bucketed, fixed = variants["bucketed"], variants["fixed_max_batch"]
+    return {
+        "metric": (f"Serving rows/sec (bucketed dynamic batcher, "
+                   f"max_batch {max_batch}, {n_threads} clients)"),
+        "value": bucketed["rows_per_sec"],
+        "unit": "rows/sec",
+        "vs_baseline": None,  # reference serves per-message; no comparable
+        "data": "synthetic",
+        "dtype": "float32",
+        "p50_ms": bucketed["p50_ms"],
+        "p99_ms": bucketed["p99_ms"],
+        "variants": variants,
+        "bucketed_vs_fixed_speedup": round(
+            bucketed["rows_per_sec"] / fixed["rows_per_sec"], 2),
+        "steady_state_compiles": steady_state_compiles,
+    }
+
+
 def main():
     baselines = _load_baselines()
     devices = _devices_with_retry()
@@ -703,7 +814,8 @@ def main():
             ("graves_lstm", lambda: bench_graves_lstm(platform, baselines, peak)),
             ("transformer", lambda: bench_transformer(platform, baselines, peak)),
             ("decode", lambda: bench_decode(platform, peak)),
-            ("long_context", lambda: bench_long_context(platform, peak))):
+            ("long_context", lambda: bench_long_context(platform, peak)),
+            ("serving", lambda: bench_serving(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

@@ -70,14 +70,24 @@ def _restore_like(template, flat: Dict[str, np.ndarray], path=()):
     return jnp.asarray(flat[key])
 
 
-def write_model(net, path, save_updater: bool = True) -> None:
+def write_model(net, path, save_updater: bool = True,
+                extra_manifest: Dict[str, Any] = None) -> None:
+    """``extra_manifest`` entries are merged into the manifest (reserved
+    keys rejected) — e.g. ``{"serving_version": 7}`` pins the version a
+    serving ``ModelRegistry`` assigns this checkpoint on hot-swap."""
+    manifest: Dict[str, Any] = {
+        "format_version": FORMAT_VERSION,
+        "model_type": type(net).__name__,
+        "iteration": net.iteration,
+        "framework": "deeplearning4j_tpu",
+    }
+    if extra_manifest:
+        clash = set(extra_manifest) & set(manifest)
+        if clash:
+            raise ValueError(f"extra_manifest may not override {sorted(clash)}")
+        manifest.update(extra_manifest)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr(MANIFEST_ENTRY, json.dumps({
-            "format_version": FORMAT_VERSION,
-            "model_type": type(net).__name__,
-            "iteration": net.iteration,
-            "framework": "deeplearning4j_tpu",
-        }))
+        zf.writestr(MANIFEST_ENTRY, json.dumps(manifest))
         zf.writestr(CONFIG_ENTRY, net.conf.to_json())
         zf.writestr(COEFFICIENTS_ENTRY, _tree_to_npz_bytes(net.params))
         if net.net_state:
@@ -86,13 +96,17 @@ def write_model(net, path, save_updater: bool = True) -> None:
             zf.writestr(UPDATER_ENTRY, _tree_to_npz_bytes(net.updater_state))
 
 
+def read_manifest(path) -> Dict[str, Any]:
+    """The checkpoint's manifest dict without loading any weights."""
+    with zipfile.ZipFile(path, "r") as zf:
+        return json.loads(zf.read(MANIFEST_ENTRY).decode())
+
+
 def load_model(path, load_updater: bool = True):
     """Generic restore dispatching on the manifest's model_type
     (≙ ``ModelSerializer.restoreMultiLayerNetwork``/``restoreComputationGraph``
     pair, but format-self-describing)."""
-    with zipfile.ZipFile(path, "r") as zf:
-        manifest = json.loads(zf.read(MANIFEST_ENTRY).decode())
-    mtype = manifest.get("model_type")
+    mtype = read_manifest(path).get("model_type")
     if mtype == "MultiLayerNetwork":
         return restore_multi_layer_network(path, load_updater)
     if mtype == "ComputationGraph":

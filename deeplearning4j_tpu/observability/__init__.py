@@ -27,6 +27,7 @@ from deeplearning4j_tpu.observability.phases import PhaseTimers
 from deeplearning4j_tpu.observability.fitmetrics import (
     FitTelemetry, fit_telemetry,
 )
+from deeplearning4j_tpu.observability.servingmetrics import ServingMetrics
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
@@ -34,5 +35,5 @@ __all__ = [
     "Span", "SpanTracer", "get_tracer", "set_tracer",
     "RecompileDetector", "compile_counter", "fingerprint", "instrument",
     "DeviceMemoryMonitor", "device_memory_stats", "sample_once",
-    "PhaseTimers", "FitTelemetry", "fit_telemetry",
+    "PhaseTimers", "FitTelemetry", "fit_telemetry", "ServingMetrics",
 ]

@@ -104,11 +104,14 @@ class RecompileDetector:
             "(shape/dtype/sharding churn)", labels=("fn",)
         ).labels(fn=name)
 
-    def check(self, args: Any, kwargs: Dict) -> bool:
+    def check(self, args: Any, kwargs: Dict, expected: bool = False) -> bool:
         """Record this call's signature (``args`` is any pytree — a tuple
         of positional args, or a position-keyed dict when the wrapper
         subsets by ``argnums``); returns True when it is new (i.e. this
-        call compiles)."""
+        call compiles).  ``expected=True`` marks a PLANNED compile (e.g.
+        serving AOT warmup sweeping its bucket shapes): it still counts
+        in ``dl4j_compiles_total`` but does not warn or count as a
+        recompile — those alert only on unplanned signature churn."""
         sig = fingerprint(args, kwargs)
         with self._lock:
             known = sig in self._seen
@@ -119,7 +122,7 @@ class RecompileDetector:
             prev, self._last = self._last, sig
         if known:
             return False
-        if prev is not None:
+        if prev is not None and not expected:
             self.recompile_count += 1
             self._m_recompiles.inc()
             self.warn(self._delta_message(prev, sig, args, kwargs))

@@ -1,0 +1,89 @@
+"""Serving metric families — the one owner of their names/labels.
+
+The serving engine, the HTTP front-end, and the bench all record through
+this bundle so the families can never be declared twice with diverging
+label sets (the registry raises on that).  Names continue the PR-1 set
+(``dl4j_serving_requests_total`` etc.) and add the engine-era families:
+bucket utilization (how much of each dispatched tile was real rows),
+shed counter by reason (queue_full / deadline / shutdown), model swap
+counter, and AOT warmup timings.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+from deeplearning4j_tpu.observability.metrics import get_registry
+
+_ENGINE_IDS = itertools.count()
+
+_ROW_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+_UTIL_BUCKETS = (0.0625, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+
+
+class ServingMetrics:
+    """All serving families, plus this engine's per-instance gauge
+    children (labeled ``server=`` with a process-unique id so a second
+    engine neither clobbers nor zeroes the first's gauges)."""
+
+    def __init__(self, registry=None, server_id: str = None):
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        self.server_id = (server_id if server_id is not None
+                          else f"s{next(_ENGINE_IDS)}")
+        self.requests = reg.counter(
+            "dl4j_serving_requests_total",
+            "Predict requests by outcome", labels=("status",))
+        self.latency = reg.histogram(
+            "dl4j_serving_request_seconds",
+            "End-to-end predict latency (enqueue -> response ready, "
+            "including micro-batching wait)")
+        self.queue_wait = reg.histogram(
+            "dl4j_serving_queue_wait_seconds",
+            "Time a request spent queued before its batch dispatched")
+        self.request_rows = reg.histogram(
+            "dl4j_serving_request_rows",
+            "Rows per predict request", buckets=_ROW_BUCKETS)
+        self.batch_rows = reg.histogram(
+            "dl4j_serving_batch_rows",
+            "Rows per dispatched micro-batch (padding excluded)",
+            buckets=_ROW_BUCKETS)
+        self.bucket_util = reg.histogram(
+            "dl4j_serving_bucket_utilization",
+            "Real rows / bucket rows per dispatched forward pass (1.0 = "
+            "no padding FLOPs wasted)", buckets=_UTIL_BUCKETS)
+        self.shed = reg.counter(
+            "dl4j_serving_shed_total",
+            "Requests shed by admission control, by reason",
+            labels=("reason",))
+        self.swaps = reg.counter(
+            "dl4j_serving_model_swaps_total",
+            "Completed model hot-swaps", labels=("model",))
+        self.warmup_seconds = reg.histogram(
+            "dl4j_serving_warmup_seconds",
+            "Wall time of one model version's AOT bucket warmup")
+        self.warmup_shapes = reg.gauge(
+            "dl4j_serving_warmup_shapes",
+            "Bucket shapes precompiled for the active version",
+            labels=("model",))
+        # per-instance children
+        self.queue_depth = reg.gauge(
+            "dl4j_serving_queue_depth",
+            "Requests waiting for the micro-batch dispatcher",
+            labels=("server",)).labels(server=self.server_id)
+        self._max_batch_fam = reg.gauge(
+            "dl4j_serving_max_batch",
+            "Configured micro-batch row budget", labels=("server",))
+
+    def set_max_batch(self, max_batch: int) -> None:
+        self._max_batch_fam.set(max_batch, server=self.server_id)
+
+    def bind_queue_depth(self, fn) -> None:
+        """Live queue-depth gauge (the caller passes a weakref-safe
+        callable so the registry never pins the engine)."""
+        self.queue_depth.set_function(fn)
+
+    def freeze_queue_depth(self) -> None:
+        """Replace the live callback with 0 at engine stop (other engines'
+        children are untouched)."""
+        self.queue_depth.set(0.0)

@@ -1,0 +1,30 @@
+"""Production serving subsystem: shape-bucketed dynamic batching, AOT
+warmup, model registry with zero-drop hot-swap, and admission control.
+
+The HTTP ``streaming.InferenceServer`` and the broker-based
+``streaming.ServingPipeline`` are thin front-ends over the
+``ServingEngine`` defined here.  See docs/serving.md.
+"""
+
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionController, DeadlineExceededError, ModelNotFoundError,
+    QueueFullError, Request, ServingError, ShuttingDownError,
+)
+from deeplearning4j_tpu.serving.batcher import DynamicBatcher
+from deeplearning4j_tpu.serving.buckets import BucketPolicy
+from deeplearning4j_tpu.serving.engine import DEFAULT_MODEL, ServingEngine
+from deeplearning4j_tpu.serving.registry import (
+    ModelRegistry, ModelVersion, load_version_from_checkpoint,
+)
+from deeplearning4j_tpu.serving.warmup import (
+    NoWarmupShapeError, infer_row_shape, warmup_version,
+)
+
+__all__ = [
+    "AdmissionController", "BucketPolicy", "DEFAULT_MODEL",
+    "DeadlineExceededError", "DynamicBatcher", "ModelNotFoundError",
+    "ModelRegistry", "ModelVersion", "NoWarmupShapeError",
+    "QueueFullError", "Request", "ServingEngine", "ServingError",
+    "ShuttingDownError", "infer_row_shape", "load_version_from_checkpoint",
+    "warmup_version",
+]

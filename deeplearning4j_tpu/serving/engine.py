@@ -1,0 +1,250 @@
+"""ServingEngine: bucketed batching + AOT warmup + hot-swap + admission.
+
+The production serving core the HTTP ``InferenceServer`` and the
+broker-based ``ServingPipeline`` are thin front-ends over.  One engine
+owns:
+
+- a ``BucketPolicy`` (the closed shape set XLA may see),
+- a ``ModelRegistry`` (named/versioned models, atomic hot-swap),
+- an ``AdmissionController`` (queue budget, deadlines, shedding),
+- a ``DynamicBatcher`` (one dispatch thread multiplexing all models),
+- a ``ServingMetrics`` bundle (Prometheus-convention families).
+
+Request path: ``predict`` normalises features, stamps a deadline,
+submits through admission, and waits BOUNDED on the result — a dead
+dispatcher or an overloaded queue surfaces as a typed error, never a
+hang.  Batches resolve their model version only at execution time (a
+registry lease), which is what makes ``deploy`` a zero-drop swap: warm
+the incoming version while the old one serves, flip atomically, let the
+old version's in-flight batches drain, retire it.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.observability.servingmetrics import ServingMetrics
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionController, DeadlineExceededError, QueueFullError, Request,
+    ServingError, ShuttingDownError,
+)
+from deeplearning4j_tpu.serving.batcher import DynamicBatcher
+from deeplearning4j_tpu.serving.buckets import BucketPolicy
+from deeplearning4j_tpu.serving.registry import (
+    ModelRegistry, ModelVersion, load_version_from_checkpoint,
+)
+from deeplearning4j_tpu.serving.warmup import (
+    NoWarmupShapeError, warmup_version,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu.serving")
+
+DEFAULT_MODEL = "default"
+
+
+class ServingEngine:
+    """See module docstring.  Minimal use::
+
+        engine = ServingEngine(model, max_batch=32,
+                               example=np.zeros((n_in,), np.float32))
+        engine.start()            # AOT-warms every bucket shape
+        out = engine.predict(x)   # thread-safe, batched, deadline-bounded
+        engine.deploy("default", new_model)   # zero-drop hot-swap
+        engine.stop()             # graceful drain
+    """
+
+    def __init__(self, model=None, *, max_batch: int = 32,
+                 max_wait_ms: float = 2.0, max_queue: int = 256,
+                 deadline_s: float = 30.0, policy: Optional[BucketPolicy] = None,
+                 models: Optional[ModelRegistry] = None, registry=None,
+                 example: Optional[np.ndarray] = None,
+                 default_model: str = DEFAULT_MODEL):
+        self.policy = policy or BucketPolicy(max_batch=max_batch)
+        self.metrics = ServingMetrics(registry)
+        self.metrics.set_max_batch(self.policy.max_batch)
+        self.models = models or ModelRegistry(
+            metrics_registry=self.metrics.registry)
+        self.default_model = default_model
+        if model is not None:
+            self.models.register(default_model, model, example=example)
+        self.admission = AdmissionController(
+            max_queue=max_queue, default_deadline_s=deadline_s,
+            metrics=self.metrics)
+        self.batcher = DynamicBatcher(
+            self._execute_batch, self.admission,
+            max_batch=self.policy.max_batch, max_wait_ms=max_wait_ms,
+            metrics=self.metrics)
+        self._bind_queue_gauge()
+        self._swap_lock = threading.Lock()
+
+    def _bind_queue_gauge(self) -> None:
+        # weakref: the registry outlives the engine — a strong closure
+        # would pin the batcher (and through it the models) forever
+        ref = weakref.ref(self.batcher)
+        self.metrics.bind_queue_depth(
+            lambda: b.queued if (b := ref()) is not None else 0.0)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        """Start the dispatcher; with ``warmup`` (default) precompile
+        every bucket shape of every registered model so steady-state
+        serving triggers zero XLA compiles.  A model whose input shape
+        cannot be derived (and that has no example) is skipped with a
+        warning — its first live shapes compile on demand instead; any
+        OTHER warmup failure means a broken model and propagates."""
+        if warmup:
+            for name in self.models.names():
+                mv = self.models.active(name)
+                try:
+                    warmup_version(mv, self.policy, metrics=self.metrics)
+                except NoWarmupShapeError as e:
+                    logger.warning("skipping warmup: %s", e)
+        self.batcher.start()
+        self._bind_queue_gauge()   # stop() freezes the gauge; re-arm it
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful shutdown: with ``drain`` every queued request is
+        still served; without, queued waiters fail with 503 — in both
+        cases no waiter is left hanging."""
+        self.batcher.stop(drain=drain, timeout=timeout)
+        self.metrics.freeze_queue_depth()
+
+    # ---------------------------------------------------------------- predict
+    def predict(self, features: np.ndarray, model: Optional[str] = None,
+                deadline_s: Optional[float] = None) -> np.ndarray:
+        """Thread-safe batched inference.  Raises ``QueueFullError``
+        (shed), ``ShuttingDownError``, ``DeadlineExceededError``, or the
+        model's own failure — bounded by the request deadline either
+        way."""
+        model = model or self.default_model
+        feats = np.asarray(features, np.float32)
+        if feats.ndim == 1:
+            feats = feats[None, :]
+        if len(feats) == 0:
+            raise ValueError("predict called with zero rows")
+        orig_seq = None
+        if self.policy.seq_buckets is not None and feats.ndim >= 3:
+            orig_seq = feats.shape[1]
+            target = self.policy.bucket_seq(orig_seq)
+            if target > orig_seq:
+                pad = np.zeros(
+                    (feats.shape[0], target - orig_seq) + feats.shape[2:],
+                    feats.dtype)
+                feats = np.concatenate([feats, pad], axis=1)
+        deadline = self.admission.deadline_for(deadline_s)
+        req = Request(feats, model, deadline, orig_seq)
+        t0 = time.perf_counter()
+        try:
+            self.batcher.submit(req)
+        except ServingError:
+            self.metrics.requests.inc(status="shed")
+            raise
+        # +grace so the queue-side deadline purge (which produces the more
+        # informative error and owns shed{reason="deadline"}) normally
+        # wins the race against this waiter
+        if not req.done.wait(max(0.0, req.deadline - time.monotonic()) + 0.5):
+            req.cancelled = True
+            # the purge may have delivered between the timeout and here —
+            # prefer its result so the shed counter is bumped exactly once
+            if not req.done.is_set():
+                self.metrics.requests.inc(status="deadline")
+                raise DeadlineExceededError(
+                    f"no result within {deadline:.3f}s deadline "
+                    f"(dispatcher dead or engine overloaded)")
+        self.metrics.latency.observe(time.perf_counter() - t0)
+        self.metrics.request_rows.observe(req.rows)
+        res = req.result[0]
+        if isinstance(res, Exception):
+            if isinstance(res, DeadlineExceededError):
+                self.metrics.requests.inc(status="deadline")
+            elif isinstance(res, (QueueFullError, ShuttingDownError)):
+                self.metrics.requests.inc(status="shed")
+            else:
+                self.metrics.requests.inc(status="error")
+            raise res
+        self.metrics.requests.inc(status="ok")
+        if (orig_seq is not None and res.ndim >= 3
+                and res.shape[1] > orig_seq):
+            res = res[:, :orig_seq]   # trim time-distributed pad steps
+        return res
+
+    # ----------------------------------------------------------- model admin
+    def deploy(self, name: str, model_or_path, *, example=None,
+               version: Optional[int] = None, warmup: bool = True,
+               drain_timeout: float = 30.0) -> ModelVersion:
+        """Register a model (or load a checkpoint path via
+        ``models/serialization.py``) as the next version of ``name`` and
+        hot-swap it in: the incoming version is warmed across all bucket
+        shapes BEFORE the atomic flip, in-flight batches finish on the
+        old version under their leases, then the old version retires.
+        No request is dropped at any point."""
+        with self._swap_lock:   # serialize swaps per engine
+            if isinstance(model_or_path, (str, bytes, os.PathLike)):
+                mv = load_version_from_checkpoint(
+                    self.models, name, model_or_path, example=example)
+            else:
+                mv = self.models.new_version(
+                    name, model_or_path, example=example, version=version)
+            if warmup:
+                # only the no-known-shape case is tolerable; a model that
+                # FAILS its warmup forward must never be activated — the
+                # raise here aborts the swap with the old version intact
+                try:
+                    warmup_version(mv, self.policy, metrics=self.metrics)
+                except NoWarmupShapeError as e:
+                    logger.warning("deploying %s unwarmed: %s", mv.key, e)
+            old = self.models.activate(mv)
+            if old is not None:
+                self.metrics.swaps.inc(model=name)
+                if not self.models.retire(old, timeout=drain_timeout):
+                    logger.warning(
+                        "old version %s still has in-flight batches after "
+                        "%.1fs; left un-retired", old.key, drain_timeout)
+            logger.info("%s now serving (replaced %s)", mv.key,
+                        old.key if old else "nothing")
+            return mv
+
+    def stats(self) -> dict:
+        """Live engine state for the HTTP /models endpoint."""
+        return {
+            "models": self.models.as_dict(),
+            "queue_depth": self.batcher.queued,
+            "max_batch": self.policy.max_batch,
+            "batch_buckets": list(self.policy.batch_buckets),
+            "seq_buckets": (list(self.policy.seq_buckets)
+                            if self.policy.seq_buckets else None),
+            "max_queue": self.admission.max_queue,
+            "dispatcher_alive": self.batcher.is_alive(),
+        }
+
+    # ------------------------------------------------------------- execution
+    def _execute_batch(self, model_name: str, feats: np.ndarray) -> np.ndarray:
+        """Forward one concatenated batch under a version lease: chunk to
+        the row budget, pad each chunk UP to its bucket (never to full
+        ``max_batch`` unless needed), fingerprint through the version's
+        recompile detector, slice the padding back off."""
+        with self.models.lease(model_name) as mv:
+            n = len(feats)
+            outs = []
+            i = 0
+            while i < n:
+                take = min(self.policy.max_batch, n - i)
+                chunk = feats[i:i + take]
+                bucket = self.policy.bucket_rows(take)
+                if bucket > take:
+                    pad = np.zeros((bucket - take,) + chunk.shape[1:],
+                                   chunk.dtype)
+                    chunk = np.concatenate([chunk, pad])
+                self.metrics.bucket_util.observe(take / bucket)
+                mv.detector.check((chunk,), {})
+                outs.append(np.asarray(mv.model.output(chunk))[:take])
+                i += take
+            return outs[0] if len(outs) == 1 else np.concatenate(outs)

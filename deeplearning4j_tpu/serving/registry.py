@@ -1,0 +1,184 @@
+"""Named/versioned model registry with atomic hot-swap.
+
+The reference redeploys a serving route by restarting it; here a new
+checkpoint is loaded and WARMED while the old version keeps serving,
+then the active pointer flips atomically.  Requests never reference a
+version until the moment their batch executes (the batcher takes a
+lease), so a swap drops zero requests: batches in flight on the old
+version run to completion under their lease, every later batch sees the
+new version, and ``retire`` blocks until the old version's in-flight
+count drains to zero before it is marked retired.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.observability.recompile import RecompileDetector
+from deeplearning4j_tpu.serving.admission import ModelNotFoundError
+
+ACTIVE = "active"
+PENDING = "pending"    # loaded + warming, not yet serving
+RETIRED = "retired"
+
+
+class ModelVersion:
+    """One immutable (model object, version) pair plus its serving state.
+
+    Each version owns its own ``RecompileDetector`` (named
+    ``serving.<model>``): a fresh version has a fresh jit cache, so its
+    warmup compiles are real compiles and must be counted."""
+
+    def __init__(self, name: str, version: int, model,
+                 example=None, metrics_registry=None):
+        self.name = name
+        self.version = int(version)
+        self.model = model
+        self.model_type = type(model).__name__   # survives model release
+        self.example = example          # single-row ndarray for warmup
+        self.state = PENDING
+        self.created = time.time()
+        self.inflight = 0               # batches currently executing
+        self.detector = RecompileDetector(
+            f"serving.{name}", registry=metrics_registry)
+
+    @property
+    def key(self) -> str:
+        return f"{self.name}@v{self.version}"
+
+    def as_dict(self) -> dict:
+        return {"name": self.name, "version": self.version,
+                "state": self.state, "inflight": self.inflight,
+                "model_type": self.model_type,
+                "compiled_signatures": self.detector.compile_count}
+
+
+class ModelRegistry:
+    """Thread-safe name -> active ModelVersion map (plus retired history).
+
+    Retiring a version RELEASES its model reference (the weights are the
+    memory cost; the history keeps only metadata) and the history itself
+    is capped — a server hot-swapping for months must not leak one model
+    per swap."""
+
+    HISTORY_LIMIT = 16
+
+    def __init__(self, metrics_registry=None):
+        self._cv = threading.Condition()
+        self._active: Dict[str, ModelVersion] = {}
+        self._history: List[ModelVersion] = []
+        self._next_version: Dict[str, int] = {}
+        self._metrics_registry = metrics_registry
+
+    # ------------------------------------------------------------ mutation
+    def new_version(self, name: str, model, example=None,
+                    version: Optional[int] = None) -> ModelVersion:
+        """Build (but do not activate) the next version of ``name`` —
+        the engine warms it up before calling ``activate``."""
+        with self._cv:
+            v = (self._next_version.get(name, 1)
+                 if version is None else int(version))
+            # a pinned (manifest) version must never rewind the counter,
+            # or a later auto-assigned version would duplicate an old one
+            self._next_version[name] = max(
+                self._next_version.get(name, 1), v + 1)
+            return ModelVersion(name, v, model, example,
+                                self._metrics_registry)
+
+    def activate(self, mv: ModelVersion) -> Optional[ModelVersion]:
+        """Atomically make ``mv`` the active version of its name;
+        returns the displaced version (still counted in-flight by any
+        executing batches) or None."""
+        with self._cv:
+            old = self._active.get(mv.name)
+            mv.state = ACTIVE
+            self._active[mv.name] = mv
+            if old is not None:
+                self._history.append(old)
+                del self._history[:-self.HISTORY_LIMIT]
+            self._cv.notify_all()
+            return old
+
+    def register(self, name: str, model, example=None,
+                 version: Optional[int] = None) -> ModelVersion:
+        """Shorthand: new version activated immediately (startup path —
+        hot-swaps go through the engine so they warm up first)."""
+        mv = self.new_version(name, model, example, version)
+        self.activate(mv)
+        return mv
+
+    def retire(self, mv: ModelVersion, timeout: float = 30.0) -> bool:
+        """Wait for ``mv``'s in-flight batches to drain, then mark it
+        retired and release its model reference (weights freed; history
+        keeps the metadata).  Returns False if the drain timed out
+        (version left un-retired with its model intact; callers may
+        retry)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while mv.inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            mv.state = RETIRED
+            mv.model = None
+            return True
+
+    # ------------------------------------------------------------- reading
+    def active(self, name: str) -> ModelVersion:
+        with self._cv:
+            mv = self._active.get(name)
+            if mv is None:
+                raise ModelNotFoundError(
+                    f"no model registered under {name!r} "
+                    f"(have: {sorted(self._active)})")
+            return mv
+
+    def names(self) -> List[str]:
+        with self._cv:
+            return sorted(self._active)
+
+    def as_dict(self) -> dict:
+        with self._cv:
+            return {
+                "active": {n: mv.as_dict()
+                           for n, mv in self._active.items()},
+                "retired": [mv.as_dict() for mv in self._history],
+            }
+
+    # -------------------------------------------------------------- leases
+    @contextlib.contextmanager
+    def lease(self, name: str):
+        """Pin the CURRENT active version for the duration of one batch
+        execution.  The swap path never blocks on leases — it only waits
+        in ``retire`` for them to drain."""
+        with self._cv:
+            mv = self._active.get(name)
+            if mv is None:
+                raise ModelNotFoundError(
+                    f"no model registered under {name!r} "
+                    f"(have: {sorted(self._active)})")
+            mv.inflight += 1
+        try:
+            yield mv
+        finally:
+            with self._cv:
+                mv.inflight -= 1
+                self._cv.notify_all()
+
+
+def load_version_from_checkpoint(registry: ModelRegistry, name: str, path,
+                                 example=None) -> ModelVersion:
+    """Build a PENDING version from a ``models/serialization.py``
+    checkpoint zip.  A ``serving_version`` entry in the checkpoint
+    manifest (see ``write_model(extra_manifest=...)``) pins the version
+    number; otherwise the registry's per-name counter assigns one."""
+    from deeplearning4j_tpu.models import serialization
+
+    model = serialization.load_model(path, load_updater=False)
+    version = serialization.read_manifest(path).get("serving_version")
+    return registry.new_version(name, model, example=example,
+                                version=version)

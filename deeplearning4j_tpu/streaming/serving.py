@@ -1,172 +1,97 @@
-"""Model serving + streaming pipelines.
+"""Model serving + streaming pipelines (front-ends over ``serving/``).
 
 Reference: ``dl4j-streaming/.../routes/DL4jServeRouteBuilder.java`` (serve a
 trained model: consume records, predict, publish predictions back) and
 ``pipeline/spark/SparkStreamingPipeline.java`` (Kafka -> record conversion ->
-DStream<DataSet> -> fit).  TPU redesign: the serving hot path batches queued
-requests before the jitted forward pass so the MXU sees full tiles instead
-of single rows, and pads to a fixed max batch so XLA never retraces.
+DStream<DataSet> -> fit).  TPU redesign: both serving front-ends here (the
+HTTP ``InferenceServer`` and the broker-based ``ServingPipeline``) delegate
+to ``deeplearning4j_tpu.serving.ServingEngine`` — shape-bucketed dynamic
+batching, AOT bucket warmup, versioned hot-swap, and admission control
+(docs/serving.md) — instead of the reference's per-message route.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import threading
-import time
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
-from deeplearning4j_tpu.observability import get_registry
+from deeplearning4j_tpu.serving import (
+    ServingEngine, ServingError, ShuttingDownError,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu.streaming")
 from deeplearning4j_tpu.streaming.pubsub import MessageBroker
 from deeplearning4j_tpu.streaming.serde import (
     array_to_base64, base64_to_array, record_to_dataset,
 )
 
 
-import itertools
-
-_SERVER_IDS = itertools.count()
-
-
 class InferenceServer:
-    """HTTP model server: POST /predict with an NDArray envelope (or a plain
-    JSON list) returns the model's output.  GET /healthz for liveness,
-    GET /metrics for a Prometheus scrape (request counters, latency
-    histograms, queue depth — see docs/observability.md).
+    """HTTP front-end over a ``ServingEngine``.
 
-    Requests that arrive concurrently are micro-batched: the handler thread
-    enqueues, a single dispatch thread pads the queue contents to
-    ``max_batch`` and runs ONE forward pass — TPU-friendly serving (large
-    static-shape batches) replacing the reference's per-message Camel route.
+    Endpoints:
+
+    - ``POST /predict`` — NDArray envelope or plain JSON list body; the
+      request joins the engine's bucketed micro-batches.  Malformed
+      bodies get a structured 400; shed requests 429; shutdown 503;
+      deadline expiry 504; model errors 400.
+    - ``GET /healthz`` — liveness (includes dispatcher state).
+    - ``GET /metrics`` — Prometheus scrape of the metrics registry.
+    - ``GET /models`` — engine/model-registry state (versions, queue).
+    - ``POST /models/<name>`` — hot-swap: body ``{"path": <checkpoint>}``
+      loads a ``models/serialization.py`` zip, warms every bucket shape,
+      and atomically swaps it in with zero dropped requests.
+
+    Constructor keeps the PR-1 signature; ``engine=`` supplies a custom
+    (possibly shared, multi-model) engine instead.
     """
 
-    def __init__(self, model, max_batch: int = 32,
-                 max_wait_ms: float = 2.0, port: int = 0, registry=None):
+    def __init__(self, model=None, max_batch: int = 32,
+                 max_wait_ms: float = 2.0, port: int = 0, registry=None,
+                 max_queue: int = 256, deadline_s: float = 30.0,
+                 example: Optional[np.ndarray] = None,
+                 engine: Optional[ServingEngine] = None):
+        if engine is None:
+            if model is None:
+                raise ValueError("InferenceServer needs a model or an engine")
+            engine = ServingEngine(
+                model, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                max_queue=max_queue, deadline_s=deadline_s,
+                registry=registry, example=example)
+            self._owns_engine = True
+        else:
+            if model is not None:
+                # the engine serves ITS registered models; silently never
+                # serving the passed one would be a trap
+                raise ValueError(
+                    "pass either model= (server builds its own engine) or "
+                    "engine= (serve that engine's models), not both — "
+                    "register extra models via engine.deploy()")
+            self._owns_engine = False
+        self.engine = engine
         self.model = model
-        self.max_batch = max_batch
-        self.max_wait_ms = max_wait_ms
+        self.max_batch = engine.policy.max_batch
+        self.max_wait_ms = engine.batcher.max_wait_s * 1000.0
+        self.registry = engine.metrics.registry
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
-        self._pending: list = []
-        self._lock = threading.Condition()
-        self._stop = False
-        # serving telemetry: scraped live from GET /metrics (Prometheus
-        # text format) on this server's own port.  Counters/histograms are
-        # additive across instances (unlabeled singletons aggregate
-        # naturally); the PER-INSTANCE gauges (queue depth callback, config)
-        # are labeled by a process-unique server id so a second server
-        # neither clobbers the first's callback nor zeroes it on stop().
-        self.registry = registry if registry is not None else get_registry()
-        self.server_id = f"s{next(_SERVER_IDS)}"
-        self._m_requests = self.registry.counter(
-            "dl4j_serving_requests_total",
-            "Predict requests by outcome", labels=("status",))
-        self._m_latency = self.registry.histogram(
-            "dl4j_serving_request_seconds",
-            "End-to-end predict latency (enqueue -> response ready, "
-            "including micro-batching wait)")
-        self._m_rows = self.registry.histogram(
-            "dl4j_serving_request_rows",
-            "Rows per predict request",
-            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
-        self._m_batch_rows = self.registry.histogram(
-            "dl4j_serving_batch_rows",
-            "Rows per dispatched micro-batch (padding excluded)",
-            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024))
-        # weakref: the registry outlives the server — a strong closure
-        # would pin the server (and its model) for process lifetime
-        import weakref
 
-        ref = weakref.ref(self)
-        self._m_queue = self.registry.gauge(
-            "dl4j_serving_queue_depth",
-            "Requests waiting for the micro-batch dispatcher",
-            labels=("server",)).labels(server=self.server_id)
-        self._m_queue.set_function(
-            lambda: len(s._pending) if (s := ref()) is not None else 0.0)
-        self.registry.gauge(
-            "dl4j_serving_max_batch",
-            "Configured micro-batch row budget",
-            labels=("server",)).set(max_batch, server=self.server_id)
-
-    # --------------------------------------------------------- micro-batcher
-    def _run_model(self, feats: np.ndarray) -> np.ndarray:
-        """Forward pass in fixed max_batch-shaped chunks: every call XLA
-        sees is exactly [max_batch, ...], so no request size ever retraces."""
-        outs = []
-        for i in range(0, len(feats), self.max_batch):
-            chunk = feats[i:i + self.max_batch]
-            n = len(chunk)
-            if n < self.max_batch:
-                pad = np.zeros((self.max_batch - n,) + chunk.shape[1:],
-                               chunk.dtype)
-                chunk = np.concatenate([chunk, pad])
-            outs.append(np.asarray(self.model.output(chunk))[:n])
-        return np.concatenate(outs)
-
-    def _dispatch_loop(self):
-        while True:
-            with self._lock:
-                while not self._pending and not self._stop:
-                    self._lock.wait(0.1)
-                if self._stop:
-                    # fail any stragglers instead of hanging their waiters
-                    for _f, done, result in self._pending:
-                        result.append(RuntimeError("server stopped"))
-                        done.set()
-                    self._pending.clear()
-                    return
-                self._lock.wait(self.max_wait_ms / 1000.0)
-                # take requests until the row budget is filled (a single
-                # oversized request is still taken alone and chunked)
-                batch, rows = [], 0
-                while self._pending and (not batch
-                                         or rows + len(self._pending[0][0])
-                                         <= self.max_batch):
-                    req = self._pending.pop(0)
-                    batch.append(req)
-                    rows += len(req[0])
-            try:
-                feats = np.concatenate([b[0] for b in batch])
-                self._m_batch_rows.observe(len(feats))
-                out = self._run_model(feats)
-                pos = 0
-                for f, done, result in batch:
-                    result.append(out[pos:pos + len(f)])
-                    pos += len(f)
-                    done.set()
-            except Exception as e:  # deliver the failure to the waiters;
-                for _f, done, result in batch:  # the loop must survive
-                    result.append(e)
-                    done.set()
-
-    def predict(self, features: np.ndarray) -> np.ndarray:
-        """Thread-safe enqueue + wait (used by the HTTP handler and usable
-        directly in-process)."""
-        features = np.asarray(features, np.float32)
-        if features.ndim == 1:
-            features = features[None, :]
-        t0 = time.perf_counter()
-        done = threading.Event()
-        result: list = []
-        with self._lock:
-            self._pending.append((features, done, result))
-            self._lock.notify_all()
-        done.wait()
-        self._m_latency.observe(time.perf_counter() - t0)
-        self._m_rows.observe(len(features))
-        if isinstance(result[0], Exception):
-            self._m_requests.inc(status="error")
-            raise result[0]
-        self._m_requests.inc(status="ok")
-        return result[0]
+    def predict(self, features: np.ndarray, model: Optional[str] = None,
+                deadline_s: Optional[float] = None) -> np.ndarray:
+        """Thread-safe enqueue + bounded wait (usable in-process without
+        HTTP).  Raises typed ``ServingError`` subclasses on shed/timeout
+        instead of ever hanging the caller."""
+        return self.engine.predict(features, model=model,
+                                   deadline_s=deadline_s)
 
     # ------------------------------------------------------------- lifecycle
-    def start(self) -> int:
+    def start(self, warmup: bool = True) -> int:
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -181,13 +106,25 @@ class InferenceServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _read_json(self):
+                """Parse the request body; raises _BadRequest (-> 400)
+                instead of letting a traceback escape as a 500."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    return json.loads(self.rfile.read(n).decode())
+                except (ValueError, UnicodeDecodeError) as e:
+                    raise _BadRequest(f"malformed JSON body: {e}")
+
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._json({"status": "ok"})
+                    # a dead dispatcher can only time requests out — fail
+                    # the probe so load balancers evict this instance
+                    alive = server.engine.batcher.is_alive()
+                    self._json({
+                        "status": "ok" if alive else "unavailable",
+                        "dispatcher_alive": alive,
+                    }, code=200 if alive else 503)
                 elif self.path == "/metrics":
-                    # Prometheus text exposition of the server's registry
-                    # (serving metrics + whatever else the process records:
-                    # fit metrics, compile counts, device memory…)
                     body = server.registry.to_prometheus().encode()
                     self.send_response(200)
                     self.send_header(
@@ -196,30 +133,65 @@ class InferenceServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path == "/models":
+                    self._json(server.engine.stats())
                 else:
                     self.send_error(404)
 
             def do_POST(self):
-                if self.path != "/predict":
-                    self.send_error(404)
-                    return
-                n = int(self.headers.get("Content-Length", 0))
-                obj = json.loads(self.rfile.read(n).decode())
-                if isinstance(obj, dict) and "data" in obj:
-                    feats = base64_to_array(obj)
-                else:
-                    feats = np.asarray(obj, np.float32)
+                try:
+                    if self.path == "/predict":
+                        self._predict()
+                    elif self.path.startswith("/models/"):
+                        self._swap(self.path[len("/models/"):])
+                    else:
+                        self.send_error(404)
+                except _BadRequest as e:
+                    self._json({"error": str(e)}, code=400)
+                except ServingError as e:
+                    self._json({"error": str(e),
+                                "type": type(e).__name__},
+                               code=e.http_status)
+                except Exception as e:  # never drop the socket without a
+                    self._json({"error": str(e),  # structured response
+                                "type": type(e).__name__}, code=500)
+
+            def _predict(self):
+                obj = self._read_json()
+                try:
+                    if isinstance(obj, dict) and "data" in obj:
+                        feats = base64_to_array(obj)
+                    else:
+                        feats = np.asarray(obj, np.float32)
+                except (ValueError, KeyError, TypeError) as e:
+                    raise _BadRequest(f"bad request envelope: {e}")
                 try:
                     out = server.predict(feats)
-                except Exception as e:  # surface model errors as 400s
+                except ServingError:
+                    raise
+                except Exception as e:  # model errors surface as 400s
                     self._json({"error": str(e)}, code=400)
                     return
                 self._json(array_to_base64(out))
 
-        self._stop = False
-        self._dispatcher = threading.Thread(target=self._dispatch_loop,
-                                            daemon=True)
-        self._dispatcher.start()
+            def _swap(self, name):
+                obj = self._read_json()
+                if not isinstance(obj, dict) or "path" not in obj:
+                    raise _BadRequest(
+                        'hot-swap body must be {"path": <checkpoint>}')
+                try:
+                    mv = server.engine.deploy(name, obj["path"])
+                except Exception as e:
+                    # unloadable file, bad zip, or a checkpoint whose
+                    # model fails its warmup forward (any exception type)
+                    # — the swap aborted and the fault is the artifact's,
+                    # so classify as a client error, not a server fault
+                    raise _BadRequest(f"cannot deploy checkpoint: {e}")
+                self._json({"model": mv.name, "version": mv.version,
+                            "state": mv.state})
+
+        if self._owns_engine:
+            self.engine.start(warmup=warmup)
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self._requested_port),
                                           Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -227,17 +199,17 @@ class InferenceServer:
         self._thread.start()
         return self._httpd.server_address[1]
 
-    def stop(self):
-        with self._lock:
-            self._stop = True
-            self._lock.notify_all()
-        # freeze THIS server's queue gauge (per-instance labeled child —
-        # other servers' callbacks are untouched)
-        self._m_queue.set(0.0)
+    def stop(self, drain: bool = True):
+        if self._owns_engine:
+            self.engine.stop(drain=drain)
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+
+
+class _BadRequest(ValueError):
+    """Client error in the HTTP body; rendered as a structured 400."""
 
 
 class StreamingPipeline:
@@ -294,36 +266,89 @@ class StreamingPipeline:
 class ServingPipeline:
     """Consume feature records from `in_topic`, predict, publish predictions
     to `out_topic`.  ≙ ``DL4jServeRouteBuilder.java`` (predictions published
-    back to a Kafka topic)."""
+    back to a Kafka topic) — but predictions route through a
+    ``ServingEngine``, so concurrent pipelines (or a pipeline plus the HTTP
+    server) sharing one engine micro-batch into bucketed forward passes
+    instead of paying a per-message ``model.output`` call."""
 
-    def __init__(self, model, broker: MessageBroker, in_topic: str,
-                 out_topic: str, transform: Optional[Callable] = None):
+    def __init__(self, model=None, broker: MessageBroker = None,
+                 in_topic: str = "features", out_topic: str = "predictions",
+                 transform: Optional[Callable] = None,
+                 engine: Optional[ServingEngine] = None,
+                 model_name: Optional[str] = None, max_batch: int = 32):
+        if broker is None:
+            raise ValueError("ServingPipeline needs a broker")
+        if engine is None:
+            if model is None:
+                raise ValueError("ServingPipeline needs a model or an engine")
+            engine = ServingEngine(model, max_batch=max_batch)
+            self._owns_engine = True
+        else:
+            self._owns_engine = False
+        self.engine = engine
         self.model = model
+        self.model_name = model_name
         self.broker = broker
         self.in_topic = in_topic
         self.out_topic = out_topic
         self.transform = transform
         self._queue = broker.subscribe(in_topic)
         self._stop = threading.Event()
+        self._engine_started = False
+        self._running = False
 
     def run(self, max_messages: Optional[int] = None, timeout: float = 1.0):
+        """Blocking consume-predict-publish loop.  An OWNED engine (no
+        ``engine=`` passed) lives only while ``run()`` executes — it is
+        started on entry and stopped on exit, so a dropped pipeline never
+        leaks the dispatch thread or pins the model; re-warming on a
+        later ``run()`` is jit-cache-warm and costs milliseconds.  A
+        SHARED engine's lifecycle belongs to its owner and is never
+        touched."""
+        if self._owns_engine and not self._engine_started:
+            self.engine.start()
+            self._engine_started = True
         served = 0
-        while not self._stop.is_set():
-            try:
-                msg = self._queue.get(timeout=timeout)
-            except Exception:
-                return
-            feats = np.asarray(json.loads(msg), np.float32)
-            if feats.ndim == 1:
-                feats = feats[None, :]
-            if self.transform is not None:
-                feats = self.transform(feats)
-            out = np.asarray(self.model.output(feats))
-            self.broker.publish(self.out_topic,
-                                json.dumps(array_to_base64(out)))
-            served += 1
-            if max_messages and served >= max_messages:
-                return
+        self._running = True
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = self._queue.get(timeout=timeout)
+                except Exception:
+                    return
+                feats = np.asarray(json.loads(msg), np.float32)
+                if feats.ndim == 1:
+                    feats = feats[None, :]
+                if self.transform is not None:
+                    feats = self.transform(feats)
+                try:
+                    out = self.engine.predict(feats, model=self.model_name)
+                except ShuttingDownError:
+                    return
+                except ServingError as e:
+                    # transient shed on a SHARED engine (queue burst,
+                    # deadline) must not kill the consumer loop
+                    logger.warning("dropping message from %r: %s",
+                                   self.in_topic, e)
+                    continue
+                self.broker.publish(self.out_topic,
+                                    json.dumps(array_to_base64(out)))
+                served += 1
+                if max_messages and served >= max_messages:
+                    return
+        finally:
+            self._running = False
+            if self._owns_engine:
+                self._shutdown_engine()
+
+    def _shutdown_engine(self):
+        if self._engine_started:
+            self.engine.stop()
+            self._engine_started = False
 
     def stop(self):
+        """Stop consuming; also covers the belt-and-braces case of an
+        owned engine started but never run."""
         self._stop.set()
+        if self._owns_engine and not self._running:
+            self._shutdown_engine()
