@@ -803,7 +803,10 @@ def main():
     platform = dev.platform
     peak = _peak_flops(dev)
 
-    from deeplearning4j_tpu.observability import PhaseTimers, get_registry
+    from deeplearning4j_tpu.observability import (
+        ClusterStatsAggregator, HealthEvaluator, PhaseTimers,
+        default_training_rules, get_flight_recorder, get_registry,
+    )
 
     phases = PhaseTimers("bench")
     metrics = []
@@ -844,6 +847,14 @@ def main():
         "observability": {
             "bench_phases": phases.as_dict(),
             "registry": get_registry().to_json(),
+            # diagnostics: the SLO verdict over everything the run
+            # recorded, the merged per-worker view, and how much flight
+            # record a post-mortem would have had to work with
+            "health": HealthEvaluator(
+                default_training_rules(),
+                component="bench").evaluate().to_dict(),
+            "cluster": ClusterStatsAggregator.from_registry(),
+            "flight_events": len(get_flight_recorder().events()),
         },
     }
     if errors:

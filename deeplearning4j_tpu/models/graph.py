@@ -24,7 +24,9 @@ import numpy as np
 
 from deeplearning4j_tpu.backend.rng import KeyStream
 from deeplearning4j_tpu.models.common import LazyScoreMixin, notify_listeners
-from deeplearning4j_tpu.observability import fit_telemetry, instrument
+from deeplearning4j_tpu.observability import (
+    crash_dump, fit_telemetry, instrument, step_guard,
+)
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn.conf import UpdaterConfig
 from deeplearning4j_tpu.nn.inputs import InputType
@@ -560,16 +562,18 @@ class ComputationGraph(LazyScoreMixin):
             tel = fit_telemetry("ComputationGraph")
             batch = len(next(iter(window[0][0].values())))
             t0 = time.perf_counter()
-            with tel.span(self.iteration):
-                xs = {k: jnp.asarray(np.stack([b[0][k] for b in window]))
-                      for k in window[0][0]}
-                ys = {k: jnp.asarray(np.stack([b[1][k] for b in window]))
-                      for k in window[0][1]}
-                rngs = jnp.stack([self._keys.next() for _ in window])
-                it0 = jnp.asarray(self.iteration, jnp.float32)
-                (self.params, self.updater_state, self.net_state,
-                 losses) = scanned(self.params, self.updater_state,
-                                   self.net_state, it0, xs, ys, rngs)
+            with step_guard("fit_window", model="ComputationGraph",
+                            iteration=self.iteration, steps=len(window)):
+                with tel.span(self.iteration):
+                    xs = {k: jnp.asarray(np.stack([b[0][k] for b in window]))
+                          for k in window[0][0]}
+                    ys = {k: jnp.asarray(np.stack([b[1][k] for b in window]))
+                          for k in window[0][1]}
+                    rngs = jnp.stack([self._keys.next() for _ in window])
+                    it0 = jnp.asarray(self.iteration, jnp.float32)
+                    (self.params, self.updater_state, self.net_state,
+                     losses) = scanned(self.params, self.updater_state,
+                                       self.net_state, it0, xs, ys, rngs)
             self.score_value = losses[-1]
             self.iteration += len(window)
             tel.record_step(time.perf_counter() - t0, batch, losses[-1],
@@ -586,21 +590,28 @@ class ComputationGraph(LazyScoreMixin):
         tuples).  MultiDataSet features/labels map positionally onto
         ``conf.inputs`` / ``conf.outputs`` (reference
         ``ComputationGraph.fit(MultiDataSetIterator)`` :599-747)."""
-        if labels is not None:
-            self._fit_one(data, labels, fmask, lmask)
-            return self
-        for batch in data:
-            if hasattr(batch, "features_masks"):  # MultiDataSet
-                x, y, fm, lm = self._unpack_multi(batch)
-                self._fit_one(x, y, fm, lm)
-            elif hasattr(batch, "features"):
-                self._fit_one(batch.features, batch.labels,
-                              batch.features_mask, batch.labels_mask)
-            else:
-                x, y = batch[0], batch[1]
-                fm = batch[2] if len(batch) > 2 else None
-                lm = batch[3] if len(batch) > 3 else None
-                self._fit_one(x, y, fm, lm)
+        try:
+            if labels is not None:
+                self._fit_one(data, labels, fmask, lmask)
+                return self
+            for batch in data:
+                if hasattr(batch, "features_masks"):  # MultiDataSet
+                    x, y, fm, lm = self._unpack_multi(batch)
+                    self._fit_one(x, y, fm, lm)
+                elif hasattr(batch, "features"):
+                    self._fit_one(batch.features, batch.labels,
+                                  batch.features_mask, batch.labels_mask)
+                else:
+                    x, y = batch[0], batch[1]
+                    fm = batch[2] if len(batch) > 2 else None
+                    lm = batch[3] if len(batch) > 3 else None
+                    self._fit_one(x, y, fm, lm)
+        except Exception as e:
+            # fit-loop exception: leave the same flight-recorder report a
+            # hang would (events + live spans + registry snapshot)
+            crash_dump("fit_exception", model="ComputationGraph",
+                       iteration=self.iteration, error=repr(e))
+            raise
         return self
 
     def _unpack_multi(self, mds):
@@ -641,15 +652,20 @@ class ComputationGraph(LazyScoreMixin):
         batch = int(next(iter(x.values())).shape[0]) if x else None
         tel = fit_telemetry("ComputationGraph")
         t0 = time.perf_counter()
-        with tel.span(self.iteration):
-            (self.params, self.updater_state, self.net_state, loss,
-             new_carries) = step(
-                self.params, self.updater_state, self.net_state,
-                jnp.asarray(float(self.iteration)), x, y, self._keys.next(),
-                None if fm is None else jax.tree_util.tree_map(jnp.asarray, fm),
-                None if lm is None else jax.tree_util.tree_map(jnp.asarray, lm),
-                carries,
-            )
+        with step_guard("fit_step", model="ComputationGraph",
+                        iteration=self.iteration):
+            with tel.span(self.iteration):
+                (self.params, self.updater_state, self.net_state, loss,
+                 new_carries) = step(
+                    self.params, self.updater_state, self.net_state,
+                    jnp.asarray(float(self.iteration)), x, y,
+                    self._keys.next(),
+                    None if fm is None else jax.tree_util.tree_map(
+                        jnp.asarray, fm),
+                    None if lm is None else jax.tree_util.tree_map(
+                        jnp.asarray, lm),
+                    carries,
+                )
         self.score_value = loss  # device scalar; fetched lazily on read
         self.iteration += 1
         tel.record_step(time.perf_counter() - t0, batch, loss, model=self)

@@ -24,7 +24,9 @@ import numpy as np
 
 from deeplearning4j_tpu.backend.rng import KeyStream
 from deeplearning4j_tpu.models.common import LazyScoreMixin, notify_listeners
-from deeplearning4j_tpu.observability import fit_telemetry, instrument
+from deeplearning4j_tpu.observability import (
+    crash_dump, fit_telemetry, instrument, step_guard,
+)
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import Layer
@@ -262,37 +264,44 @@ class MultiLayerNetwork(LazyScoreMixin):
         scanned = self._jit_cache.setdefault(
             "scanned_step", self._make_scanned_step())
         step = self._get_train_step()
-        for _ in range(epochs):
-            window: list = []
-            for batch in batches:
-                x, y, fm, lm = self._unpack(batch)
-                if fm is not None or lm is not None:
-                    raise ValueError("fit_scanned does not support masks")
-                x, y = np.asarray(x), np.asarray(y)
-                if window and (window[0][0].shape != x.shape
-                               or window[0][1].shape != y.shape):
+        try:
+            for _ in range(epochs):
+                window: list = []
+                for batch in batches:
+                    x, y, fm, lm = self._unpack(batch)
+                    if fm is not None or lm is not None:
+                        raise ValueError("fit_scanned does not support masks")
+                    x, y = np.asarray(x), np.asarray(y)
+                    if window and (window[0][0].shape != x.shape
+                                   or window[0][1].shape != y.shape):
+                        self._flush_window(window, scanned, step, scan_steps)
+                        window = []
+                    window.append((x, y))
+                    if len(window) == scan_steps:
+                        self._flush_window(window, scanned, step, scan_steps)
+                        window = []
+                if window:
                     self._flush_window(window, scanned, step, scan_steps)
-                    window = []
-                window.append((x, y))
-                if len(window) == scan_steps:
-                    self._flush_window(window, scanned, step, scan_steps)
-                    window = []
-            if window:
-                self._flush_window(window, scanned, step, scan_steps)
+        except Exception as e:
+            crash_dump("fit_exception", model="MultiLayerNetwork",
+                       iteration=self.iteration, error=repr(e))
+            raise
         return self
 
     def _flush_window(self, window, scanned, step, scan_steps):
         if len(window) == scan_steps:
             tel = fit_telemetry("MultiLayerNetwork")
             t0 = time.perf_counter()
-            with tel.span(self.iteration):
-                xs = jnp.asarray(np.stack([b[0] for b in window]))
-                ys = jnp.asarray(np.stack([b[1] for b in window]))
-                rngs = jnp.stack([self._keys.next() for _ in window])
-                it0 = jnp.asarray(self.iteration, jnp.float32)
-                (self.params, self.updater_state, self.net_state,
-                 losses) = scanned(self.params, self.updater_state,
-                                   self.net_state, it0, xs, ys, rngs)
+            with step_guard("fit_window", model="MultiLayerNetwork",
+                            iteration=self.iteration, steps=len(window)):
+                with tel.span(self.iteration):
+                    xs = jnp.asarray(np.stack([b[0] for b in window]))
+                    ys = jnp.asarray(np.stack([b[1] for b in window]))
+                    rngs = jnp.stack([self._keys.next() for _ in window])
+                    it0 = jnp.asarray(self.iteration, jnp.float32)
+                    (self.params, self.updater_state, self.net_state,
+                     losses) = scanned(self.params, self.updater_state,
+                                       self.net_state, it0, xs, ys, rngs)
             self.score_value = losses[-1]
             self.iteration += len(window)
             tel.record_step(time.perf_counter() - t0, len(window[0][0]),
@@ -315,12 +324,19 @@ class MultiLayerNetwork(LazyScoreMixin):
         """Train.  ``data`` is a DataSetIterator-style iterable of
         (features, labels[, fmask, lmask]) tuples, or a single (X, y) pair.
         Reference: ``MultiLayerNetwork.fit(DataSetIterator)`` :1029."""
-        if labels is not None:
-            batches = [(data, labels, fmask, lmask)]
-            self._fit_batches(batches)
-            return self
-        for _ in range(epochs):
-            self._fit_batches(data)
+        try:
+            if labels is not None:
+                batches = [(data, labels, fmask, lmask)]
+                self._fit_batches(batches)
+                return self
+            for _ in range(epochs):
+                self._fit_batches(data)
+        except Exception as e:
+            # fit-loop exception: leave the same flight-recorder report a
+            # hang would (events + live spans + registry snapshot)
+            crash_dump("fit_exception", model="MultiLayerNetwork",
+                       iteration=self.iteration, error=repr(e))
+            raise
         return self
 
     def _fit_batches(self, batches):
@@ -364,15 +380,17 @@ class MultiLayerNetwork(LazyScoreMixin):
         it = jnp.asarray(self.iteration, jnp.float32)
         tel = fit_telemetry("MultiLayerNetwork")
         t0 = time.perf_counter()
-        with tel.span(self.iteration):
-            (self.params, self.updater_state, self.net_state, loss,
-             new_carries) = step(
-                self.params, self.updater_state, self.net_state, it,
-                jnp.asarray(x), jnp.asarray(y), rng,
-                None if fm is None else jnp.asarray(fm),
-                None if lm is None else jnp.asarray(lm),
-                carries,
-            )
+        with step_guard("fit_step", model="MultiLayerNetwork",
+                        iteration=self.iteration):
+            with tel.span(self.iteration):
+                (self.params, self.updater_state, self.net_state, loss,
+                 new_carries) = step(
+                    self.params, self.updater_state, self.net_state, it,
+                    jnp.asarray(x), jnp.asarray(y), rng,
+                    None if fm is None else jnp.asarray(fm),
+                    None if lm is None else jnp.asarray(lm),
+                    carries,
+                )
         self.score_value = loss  # device scalar; fetched lazily on read
         self.iteration += 1
         tel.record_step(time.perf_counter() - t0, int(np.shape(x)[0]), loss,

@@ -28,6 +28,16 @@ from deeplearning4j_tpu.observability.fitmetrics import (
     FitTelemetry, fit_telemetry,
 )
 from deeplearning4j_tpu.observability.servingmetrics import ServingMetrics
+from deeplearning4j_tpu.observability.health import (
+    ClusterStatsAggregator, HealthEvaluator, HealthRule, HealthVerdict,
+    StragglerDetector, WorkerTelemetry, default_serving_rules,
+    default_training_rules, histogram_quantile,
+)
+from deeplearning4j_tpu.observability.flightrecorder import (
+    FlightEvent, FlightRecorder, StepWatchdog, crash_dump,
+    dump_flight_report, get_flight_recorder, get_watchdog,
+    read_flight_report, set_flight_recorder, step_guard,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
@@ -36,4 +46,10 @@ __all__ = [
     "RecompileDetector", "compile_counter", "fingerprint", "instrument",
     "DeviceMemoryMonitor", "device_memory_stats", "sample_once",
     "PhaseTimers", "FitTelemetry", "fit_telemetry", "ServingMetrics",
+    "ClusterStatsAggregator", "HealthEvaluator", "HealthRule",
+    "HealthVerdict", "StragglerDetector", "WorkerTelemetry",
+    "default_serving_rules", "default_training_rules", "histogram_quantile",
+    "FlightEvent", "FlightRecorder", "StepWatchdog", "crash_dump",
+    "dump_flight_report", "get_flight_recorder", "get_watchdog",
+    "read_flight_report", "set_flight_recorder", "step_guard",
 ]

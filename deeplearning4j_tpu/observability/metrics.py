@@ -32,9 +32,14 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 
 
 def _as_float(v: Any) -> float:
-    """Resolve a lazily-stored gauge value (callable or device scalar)."""
+    """Resolve a lazily-stored gauge value (callable or device scalar).
+    A raising callback degrades to NaN — a broken live gauge must never
+    take down a /metrics scrape or a flight-recorder dump."""
     if callable(v):
-        v = v()
+        try:
+            v = v()
+        except Exception:
+            return float("nan")
     try:
         return float(v)
     except (TypeError, ValueError):
@@ -166,28 +171,46 @@ class Histogram:
     def max(self) -> float:
         return self._max if self._count else float("nan")
 
-    def cumulative_buckets(self) -> List[Tuple[float, int]]:
-        """(upper_bound, cumulative_count) pairs, +Inf last."""
-        out, running = [], 0
+    def snapshot(self) -> Dict[str, Any]:
+        """One CONSISTENT view of the histogram taken under a single lock
+        acquisition: count/sum/min/max and the cumulative buckets all
+        describe the same instant, even while other threads keep
+        observing (the watchdog, the dispatcher, and the fit loop now
+        read histograms concurrently with writers)."""
         with self._lock:
-            for b, c in zip(self.buckets, self._bucket_counts):
-                running += c
-                out.append((b, running))
-            out.append((math.inf, self._count))
+            count = self._count
+            bucket_counts = list(self._bucket_counts)
+            out = {
+                "count": count,
+                "sum": self._sum,
+                "min": self._min if count else None,
+                "max": self._max if count else None,
+            }
+        cum, running = [], 0
+        for b, c in zip(self.buckets, bucket_counts):
+            running += c
+            cum.append((b, running))
+        cum.append((math.inf, count))
+        out["cumulative_buckets"] = cum
+        out["bucket_counts"] = bucket_counts
         return out
 
+    def cumulative_buckets(self) -> List[Tuple[float, int]]:
+        """(upper_bound, cumulative_count) pairs, +Inf last."""
+        return self.snapshot()["cumulative_buckets"]
+
     def to_dict(self) -> Dict[str, Any]:
-        with self._lock:
-            return {
-                "count": self._count,
-                "sum": self._sum,
-                "min": self._min if self._count else None,
-                "max": self._max if self._count else None,
-                "buckets": {
-                    _fmt_value(b): c
-                    for b, c in zip(self.buckets, self._bucket_counts)
-                },
-            }
+        snap = self.snapshot()
+        return {
+            "count": snap["count"],
+            "sum": snap["sum"],
+            "min": snap["min"],
+            "max": snap["max"],
+            "buckets": {
+                _fmt_value(b): c
+                for b, c in zip(self.buckets, snap["bucket_counts"])
+            },
+        }
 
 
 class _HistogramTimer:
@@ -362,16 +385,21 @@ class MetricsRegistry:
             for label_pairs, child in fam.samples():
                 base = list(label_pairs)
                 if isinstance(child, Histogram):
-                    for bound, cum in child.cumulative_buckets():
+                    # one consistent snapshot per child: sum/count/buckets
+                    # must describe the same instant under concurrent
+                    # observe() calls
+                    snap = child.snapshot()
+                    for bound, cum in snap["cumulative_buckets"]:
                         le = "+Inf" if math.isinf(bound) else _fmt_value(bound)
                         lines.append(
                             f"{fam.name}_bucket"
                             f"{_fmt_labels(base + [('le', le)])} {cum}")
                     lines.append(
                         f"{fam.name}_sum{_fmt_labels(base)} "
-                        f"{_fmt_value(child.sum)}")
+                        f"{_fmt_value(snap['sum'])}")
                     lines.append(
-                        f"{fam.name}_count{_fmt_labels(base)} {child.count}")
+                        f"{fam.name}_count{_fmt_labels(base)} "
+                        f"{snap['count']}")
                 else:
                     lines.append(
                         f"{fam.name}{_fmt_labels(base)} "

@@ -99,6 +99,12 @@ class PhaseTimers:
             ).labels(component=self.component, phase=name)
         return _Timer(local, self._shared.get(name))
 
+    def totals(self) -> Dict[str, float]:
+        """Cumulative seconds per phase — cheap enough to snapshot before/
+        after a batch for per-batch phase deltas (pipeline per-stage
+        attribution)."""
+        return {name: h.sum for name, h in self._local.items()}
+
     def as_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {"steps": self.steps, "phases": {}}
         for name, h in self._local.items():

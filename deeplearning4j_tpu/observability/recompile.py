@@ -122,6 +122,15 @@ class RecompileDetector:
             prev, self._last = self._last, sig
         if known:
             return False
+        # compiles land in the flight record too: "what happened right
+        # before the hang" is usually a compile or a shape change
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            get_flight_recorder,
+        )
+
+        get_flight_recorder().record(
+            "compile", fn=self.name, ordinal=self.compile_count,
+            expected=bool(expected))
         if prev is not None and not expected:
             self.recompile_count += 1
             self._m_recompiles.inc()

@@ -19,7 +19,7 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 
 class Span:
@@ -83,13 +83,51 @@ class SpanTracer:
         self._tls = threading.local()
         self._lock = threading.Lock()
         self._finished: deque = deque(maxlen=max_spans)
+        # registration id -> (thread object, live stack list).  The stack
+        # is the SAME list the owning thread mutates; registering it here
+        # lets the watchdog read every thread's in-flight spans at dump
+        # time.  Keyed by a monotonic id, NOT thread ident: CPython
+        # recycles idents immediately, so a new thread would overwrite a
+        # dead thread's retained open-span entry — exactly the crash
+        # evidence live_spans() promises to keep.
+        self._live: Dict[int, Tuple[threading.Thread, List[Span]]] = {}
+        self._live_ids = itertools.count(1)
         self.dropped = 0  # finished spans evicted by the bound
 
     def _stack(self) -> List[Span]:
         st = getattr(self._tls, "stack", None)
         if st is None:
             st = self._tls.stack = []
+            t = threading.current_thread()
+            with self._lock:
+                self._live[next(self._live_ids)] = (t, st)
         return st
+
+    def live_spans(self) -> List[Dict[str, Any]]:
+        """In-flight (unfinished) spans across ALL threads, outermost
+        first per thread, each dict annotated with ``thread`` and
+        ``depth``.  Reading copies each stack once; the owning thread may
+        race an append/pop, which at worst makes the copy one span stale
+        — acceptable for a diagnosis dump, and safe under CPython.
+
+        Entries for threads that have exited with an EMPTY stack are
+        pruned here (thread churn — per-fit prefetch workers, handler
+        threads — must not grow ``_live`` for the process lifetime); a
+        dead thread that still holds open spans is kept, since "this
+        thread died inside span X" is exactly what a crash dump needs."""
+        with self._lock:
+            for rid in [rid for rid, (t, st) in self._live.items()
+                        if not t.is_alive() and not st]:
+                del self._live[rid]
+            stacks = list(self._live.values())
+        out: List[Dict[str, Any]] = []
+        for t, stack in stacks:
+            for depth, s in enumerate(list(stack)):
+                d = s.to_dict()
+                d["thread"] = t.name
+                d["depth"] = depth
+                out.append(d)
+        return out
 
     @contextmanager
     def span(self, name: str, **attrs) -> Iterator[Span]:

@@ -36,15 +36,34 @@ class ScoreIterationListener(IterationListener):
 
 
 class PerformanceListener(IterationListener):
-    """Throughput: samples/sec, batches/sec, iteration wall time."""
+    """Throughput: samples/sec, batches/sec, iteration wall time.
 
-    def __init__(self, frequency: int = 1, report: Optional[Callable] = None):
+    Beyond the per-iteration instant numbers it keeps a rolling window
+    (last ``window`` iterations) whose smoothed samples/sec rides along in
+    every report, and — when the caller knows the run length
+    (``total_iterations``) — an ETA extrapolated from the rolling mean
+    iteration time.  An unknown epoch/run length is fine: the ETA simply
+    stays out of the report (most streaming iterators cannot predict
+    their length)."""
+
+    def __init__(self, frequency: int = 1, report: Optional[Callable] = None,
+                 total_iterations: Optional[int] = None, window: int = 50):
+        from collections import deque
+
         self.freq = max(1, frequency)
         self.report = report or logger.info
+        self.total_iterations = total_iterations
         self._last_time: Optional[float] = None
         self.last_samples_per_sec: Optional[float] = None
         self.last_iteration_ms: Optional[float] = None
+        self.rolling_samples_per_sec: Optional[float] = None
+        self.eta_seconds: Optional[float] = None
         self._batch_size: Optional[int] = None
+        self._dts = deque(maxlen=max(2, window))
+        self._samples = deque(maxlen=max(2, window))
+        self._seen = 0   # iterations THIS listener observed (the model's
+        # global counter survives resumes/earlier fits and would zero the
+        # ETA of any run that isn't the model's first)
 
     def set_batch_size(self, n: int):
         """Called automatically by the fit loops with the actual minibatch
@@ -62,11 +81,25 @@ class PerformanceListener(IterationListener):
             bs = self._batch_size or getattr(model, "last_batch_size", None)
             if bs:
                 self.last_samples_per_sec = bs / dt
+            self._dts.append(dt)
+            self._samples.append(bs or 0)
+            wall = sum(self._dts)
+            if wall > 0 and sum(self._samples):
+                self.rolling_samples_per_sec = sum(self._samples) / wall
+            if self.total_iterations:
+                remaining = max(0, self.total_iterations - (self._seen + 1))
+                self.eta_seconds = remaining * (wall / len(self._dts))
             if iteration % self.freq == 0:
                 msg = f"iteration {iteration}; iteration time: {self.last_iteration_ms:.2f} ms"
                 if self.last_samples_per_sec:
                     msg += f"; samples/sec: {self.last_samples_per_sec:.2f}"
+                if self.rolling_samples_per_sec:
+                    msg += (f"; rolling samples/sec: "
+                            f"{self.rolling_samples_per_sec:.2f}")
+                if self.eta_seconds is not None:
+                    msg += f"; ETA: {self.eta_seconds:.1f}s"
                 self.report(msg)
+        self._seen += 1
         self._last_time = now
 
 

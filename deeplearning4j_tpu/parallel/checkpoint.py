@@ -157,6 +157,11 @@ def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = No
                 jax.random.key_data(keys._key)).tolist()
         with open(os.path.join(directory, META), "w") as f:
             json.dump(meta, f)
+    from deeplearning4j_tpu.observability import get_flight_recorder
+
+    get_flight_recorder().record(
+        "checkpoint", directory=str(directory), process=proc,
+        iteration=int(getattr(net, "iteration", 0)))
 
 
 # --------------------------------------------------------------------- restore

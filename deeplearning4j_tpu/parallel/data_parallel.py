@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -35,7 +36,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.backend import device as backend
-from deeplearning4j_tpu.observability import PhaseTimers, get_registry, instrument
+from deeplearning4j_tpu.observability import (
+    PhaseTimers, WorkerTelemetry, get_registry, instrument, step_guard,
+)
 from deeplearning4j_tpu.optimize import updaters as upd
 
 
@@ -127,6 +130,7 @@ class ParallelWrapper:
         averaging_frequency: int = 1,
         average_updaters: bool = True,
         mesh: Optional[Mesh] = None,
+        collect_worker_stats: bool = False,
     ):
         self.net = net
         self.mesh = mesh or backend.default_mesh()
@@ -144,6 +148,14 @@ class ParallelWrapper:
         # wait≙time blocked on window assembly (host ETL), dispatch≙the
         # vmapped train window + averaging all-reduce
         self._phases = PhaseTimers("parallel_wrapper")
+        # per-replica step time + throughput -> labeled registry families
+        # + straggler detection (SparkNet/DeepSpark: the run goes at the
+        # slowest replica's speed).  OPT-IN because the measurement costs
+        # one device sync per window, which breaks the default loop's
+        # async overlap of host window-assembly with device execution
+        # (same gating as SyncTrainingMaster's collect_stats).
+        self.collect_worker_stats = collect_worker_stats
+        self._workers: Optional[WorkerTelemetry] = None
 
     # -- sharding specs ----------------------------------------------------
     def _replica_sharding(self):
@@ -246,22 +258,34 @@ class ParallelWrapper:
             "dl4j_parallel_replicas",
             "Data-parallel replica count of the active ParallelWrapper",
         ).set(K)
+        if self.collect_worker_stats and self._workers is None:
+            self._workers = WorkerTelemetry("parallel_wrapper")
         it = net.iteration
         last_losses = None
         win_iter = iter(windows)
         while True:
+            t_wait0 = time.perf_counter()
             with self._phases.phase("wait_window"):
                 win = next(win_iter, None)
+            wait_s = time.perf_counter() - t_wait0
             if win is None:
                 break
             xs, ys, fms, lms, n_batches = win
-            with self._phases.phase("dispatch"):
-                rngs = jax.random.split(self.net._keys.next(),
-                                        xs.shape[0] * K).reshape(xs.shape[0], K)
-                params_k, upd_k, ns_k, last_losses = self._step_fn(
-                    params_k, upd_k, ns_k, jnp.asarray(float(it)),
-                    jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
-                )
+            t_disp0 = time.perf_counter()
+            with step_guard("parallel_window",
+                            component="parallel_wrapper", iteration=it):
+                with self._phases.phase("dispatch"):
+                    rngs = jax.random.split(
+                        self.net._keys.next(),
+                        xs.shape[0] * K).reshape(xs.shape[0], K)
+                    params_k, upd_k, ns_k, last_losses = self._step_fn(
+                        params_k, upd_k, ns_k, jnp.asarray(float(it)),
+                        jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
+                    )
+                if self.collect_worker_stats:
+                    self._publish_worker_stats(
+                        last_losses, time.perf_counter() - t_disp0,
+                        wait_s, xs)
             it += n_batches // K
             self._phases.steps += 1
 
@@ -279,6 +303,81 @@ class ParallelWrapper:
         """Per-phase wall-time aggregates of this wrapper's fit loop
         (same schema as ``TrainingMaster.training_stats()['phases']``)."""
         return self._phases.as_dict()
+
+    # -- per-worker diagnosis ---------------------------------------------
+    def _worker_step_times(self, losses, dispatch_s: float) -> Dict[str, float]:
+        """Per-replica completion time of the last window: blocks on each
+        replica's loss shard in device order and adds its arrival offset
+        to the dispatch time.
+
+        Measurement honesty: the window program ends in the parameter-
+        averaging all-reduce, and a collective gates every device on the
+        slowest one — so shard readiness reflects the CLUSTER critical
+        path (the slow replica sets everyone's time), not per-replica
+        blame, and the sequential poll means a slow first-polled shard
+        masks later ones.  What this yields in-process is an accurate
+        cluster step-time distribution (the thing SLO rules and p99s
+        read).  Per-replica ATTRIBUTION comes from feeding
+        ``WorkerTelemetry.observe`` with externally measured times — a
+        multi-process driver timing its own host, a chaos harness, or
+        the tests — through exactly this seam (override this method).
+        When the loss is not addressably sharded per replica, the whole
+        window is synced and its WALL time (dispatch + execution — not
+        just the async enqueue time, which would report microsecond
+        "steps" and wildly inflated throughput) is attributed to every
+        worker."""
+        K = self.workers
+
+        def blocked_total() -> Dict[str, float]:
+            t0 = time.perf_counter()
+            try:
+                jax.block_until_ready(losses)
+            except Exception:
+                pass
+            total = dispatch_s + (time.perf_counter() - t0)
+            return {str(k): total for k in range(K)}
+
+        if losses is None:
+            return {str(k): dispatch_s for k in range(K)}
+        try:
+            shards = list(losses.addressable_shards)
+        except Exception:
+            return blocked_total()
+        if len(shards) < 2:
+            return blocked_total()
+        times = {str(k): dispatch_s for k in range(K)}
+        t0 = time.perf_counter()
+        for sh in shards:
+            try:
+                jax.block_until_ready(sh.data)
+            except Exception:
+                continue
+            arrive = time.perf_counter() - t0
+            idx = sh.index  # slices into the global [F, K] loss array
+            if (isinstance(idx, tuple) and len(idx) >= 2
+                    and isinstance(idx[1], slice)):
+                for k in range(*idx[1].indices(K)):
+                    times[str(k)] = dispatch_s + arrive
+        return times
+
+    def _publish_worker_stats(self, losses, dispatch_s: float,
+                              wait_s: float, xs) -> None:
+        F = max(1, int(xs.shape[0]))
+        B = int(xs.shape[2]) if xs.ndim >= 3 else None
+        for worker, t in self._worker_step_times(losses, dispatch_s).items():
+            self._workers.observe(
+                worker, t / F, batch=B,
+                phases={"wait_window": wait_s / F, "dispatch": t / F})
+
+    def cluster_stats(self) -> Dict[str, Any]:
+        """Merged per-replica view (mean/p50/p99/max step time, slowest
+        worker, total throughput) — empty before the first window or when
+        ``collect_worker_stats=False``."""
+        return self._workers.cluster_view() if self._workers else {}
+
+    @property
+    def straggler_detector(self):
+        return self._workers.detector if self._workers else None
 
     def _stack_window(self, window):
         """Host half of a window step: pad + stack to [F, K, B, ...].

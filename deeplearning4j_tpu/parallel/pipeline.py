@@ -66,7 +66,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.backend.compat import pcast, shard_map
 
 from deeplearning4j_tpu.models.common import notify_listeners
-from deeplearning4j_tpu.observability import PhaseTimers, instrument
+from deeplearning4j_tpu.observability import (
+    PhaseTimers, WorkerTelemetry, instrument, step_guard,
+)
 from deeplearning4j_tpu.optimize import updaters as upd
 from deeplearning4j_tpu.parallel.training_master import TrainingMaster
 
@@ -224,13 +226,23 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         # registry-backed phase timers: whole-step dispatch on the compiled
         # paths; per-stage forward/backward dispatch on the orchestrated one
         self._phases = PhaseTimers("pipeline_master")
+        # orchestrated path: per-STAGE step time published as
+        # dl4j_worker_step_seconds{component="pipeline_master",
+        # worker="stage<s>"} — stage imbalance is the pipeline's straggler
+        # (the max stage bounds the bottleneck tick).  The compiled paths
+        # run all stages inside one XLA program, so there is no per-stage
+        # host timing to publish there.
+        self._workers: Optional[WorkerTelemetry] = None
 
     def training_stats(self) -> Dict[str, Any]:
         """Phase-timed stats: whole-step ``dispatch`` on the compiled paths,
         ``stage{s}_fwd``/``stage{s}_bwd`` dispatch on the orchestrated one
         (same schema as the other masters; also in the registry as
         ``dl4j_phase_seconds{component="pipeline_master"}``)."""
-        return self._phases.as_dict()
+        out = self._phases.as_dict()
+        if self._workers is not None:
+            out["cluster"] = self._workers.cluster_view()
+        return out
 
     def bubble_fraction(self) -> float:
         """Analytic pipeline bubble: of the M + S - 1 schedule ticks, S - 1
@@ -701,9 +713,12 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             if key not in self._compiled_steps:
                 self._compiled_steps[key] = self._make_hetero_step(
                     net, xs.shape[1:], xs.dtype)
-            with self._phases.phase("dispatch"):
-                tree, opt_state, loss = self._compiled_steps[key](
-                    tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
+            with step_guard("pipeline_step", component="pipeline_master",
+                            iteration=net.iteration):
+                with self._phases.phase("dispatch"):
+                    tree, opt_state, loss = self._compiled_steps[key](
+                        tree, opt_state, jnp.asarray(float(net.iteration)),
+                        xs, ys)
             net.score_value = loss
             net.iteration += 1
             self._phases.steps += 1
@@ -888,9 +903,12 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             if key not in self._compiled_steps:
                 self._compiled_steps[key] = self._make_compiled_step(
                     net, xs.shape[1:], xs.dtype)
-            with self._phases.phase("dispatch"):
-                tree, opt_state, loss = self._compiled_steps[key](
-                    tree, opt_state, jnp.asarray(float(net.iteration)), xs, ys)
+            with step_guard("pipeline_step", component="pipeline_master",
+                            iteration=net.iteration):
+                with self._phases.phase("dispatch"):
+                    tree, opt_state, loss = self._compiled_steps[key](
+                        tree, opt_state, jnp.asarray(float(net.iteration)),
+                        xs, ys)
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             self._phases.steps += 1
@@ -922,8 +940,12 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             for s in range(S)
         ]
 
+        if self._workers is None:
+            self._workers = WorkerTelemetry("pipeline_master")
         for ds in iterator:
-            loss = self._train_batch(net, ds, stage_params, stage_upd)
+            with step_guard("pipeline_step", component="pipeline_master",
+                            iteration=net.iteration):
+                loss = self._train_batch(net, ds, stage_params, stage_upd)
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             self._phases.steps += 1
@@ -943,6 +965,7 @@ class PipelineParallelTrainingMaster(TrainingMaster):
     def _train_batch(self, net, ds, stage_params, stage_upd):
         if ds.features_mask is not None or ds.labels_mask is not None:
             raise ValueError("pipeline master does not support masked batches")
+        phase_t0 = self._phases.totals()
         S = len(self.stages)
         M = self.n_microbatches
         x = jnp.asarray(ds.features)
@@ -1019,6 +1042,19 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                      if (u := updates.get(ln)) else stage_params[s][ln])
                 for ln in stage_params[s]
             }
+        # per-stage dispatch time this batch (phase-total deltas) -> the
+        # worker families + straggler detector; an unbalanced stage split
+        # shows up as worker "stage<s>" straggling
+        if self._workers is not None:
+            t1 = self._phases.totals()
+            for s in range(S):
+                fwd = (t1.get(f"stage{s}_fwd", 0.0)
+                       - phase_t0.get(f"stage{s}_fwd", 0.0))
+                bwd = (t1.get(f"stage{s}_bwd", 0.0)
+                       - phase_t0.get(f"stage{s}_bwd", 0.0))
+                self._workers.observe(f"stage{s}", fwd + bwd,
+                                      phases={"fwd": fwd, "bwd": bwd})
+
         # score matches serial _loss_fn: data loss + regularization penalty
         return (sum(jax.device_get(l) for l in losses) / M
                 + sum(float(r) for r in reg_vals))

@@ -29,6 +29,7 @@ The ``TrainingMaster`` SPI is kept as the strategy seam, like the reference.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Dict, Iterable, Optional
 
 import jax
@@ -37,7 +38,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.backend import device as backend
-from deeplearning4j_tpu.observability import PhaseTimers, instrument
+from deeplearning4j_tpu.observability import (
+    PhaseTimers, WorkerTelemetry, crash_dump, instrument, step_guard,
+)
 from deeplearning4j_tpu.optimize import updaters as upd
 
 
@@ -101,6 +104,10 @@ class SyncTrainingMaster(TrainingMaster):
         # aggregate), device_sync = host sync on the step result.
         self._phases = PhaseStats(enabled=collect_stats,
                                   component="sync_master")
+        # per-device step time (published only under collect_stats — the
+        # per-shard arrival measurement IS a device sync, which that mode
+        # already pays in its device_sync phase)
+        self._workers: Optional[WorkerTelemetry] = None
         self._step = None
 
     def _param_layout(self, net):
@@ -158,8 +165,6 @@ class SyncTrainingMaster(TrainingMaster):
         self._upd_layout = ulayers
 
     def execute_training(self, net, iterator):
-        import time
-
         from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
         from deeplearning4j_tpu.models.common import notify_listeners
 
@@ -192,26 +197,68 @@ class SyncTrainingMaster(TrainingMaster):
                     jnp.asarray(ds.features_mask), self._data_sharding)
                 lm = None if ds.labels_mask is None else jax.device_put(
                     jnp.asarray(ds.labels_mask), self._data_sharding)
-            with self._phases.phase("dispatch"):
-                params, upd_state, ns, loss = self._step(
-                    params, upd_state, ns, jnp.asarray(float(net.iteration)),
-                    x, y, net._keys.next(), fm, lm,
-                )
+            with step_guard("sync_step", component="sync_master",
+                            iteration=net.iteration):
+                with self._phases.phase("dispatch"):
+                    params, upd_state, ns, loss = self._step(
+                        params, upd_state, ns,
+                        jnp.asarray(float(net.iteration)),
+                        x, y, net._keys.next(), fm, lm,
+                    )
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             if self.collect_stats:
+                if self._workers is None:
+                    self._workers = WorkerTelemetry("sync_master")
                 with self._phases.phase("device_sync"):
-                    jax.block_until_ready(loss)
-                self._stats["step_time_ms"].append((time.perf_counter() - t0) * 1e3)
+                    worker_times = self._measure_worker_sync(loss, t0)
+                step_s = time.perf_counter() - t0
+                self._stats["step_time_ms"].append(step_s * 1e3)
+                per_dev = max(1, len(ds) // K)
+                for worker, w_s in (worker_times
+                                    or {str(i): step_s
+                                        for i in range(K)}).items():
+                    self._workers.observe(worker, w_s, batch=per_dev)
             self._stats["steps"] += 1
             self._phases.steps += 1
             notify_listeners(net, n_real)
         net.params, net.updater_state, net.net_state = params, upd_state, ns
 
+    def _measure_worker_sync(self, loss, t_step0: float) -> Dict[str, float]:
+        """Device-sync on the step result, measuring each device's shard
+        arrival relative to the host step start.  Blocking the shards in
+        turn completes no later than the single ``block_until_ready`` it
+        replaces.
+
+        Measurement honesty: the loss is the all-reduced replicated
+        scalar, and the collective gates every device on the slowest one
+        — so the per-device times here share the cluster critical path
+        rather than attributing blame (post-collective skew, e.g. the
+        updater apply, is the visible part).  They give the registry an
+        accurate per-step cluster distribution; real per-worker
+        attribution arrives via ``WorkerTelemetry.observe`` from
+        per-host timing in multi-process deployments (this method is the
+        in-process seam)."""
+        times: Dict[str, float] = {}
+        try:
+            shards = list(loss.addressable_shards)
+        except Exception:
+            shards = []
+        for sh in shards:
+            try:
+                jax.block_until_ready(sh.data)
+            except Exception:
+                continue
+            times[f"d{sh.device.id}"] = time.perf_counter() - t_step0
+        jax.block_until_ready(loss)
+        return times
+
     def training_stats(self):
         out = dict(self._stats)
         out["step_time_ms"] = list(out["step_time_ms"])  # JSON-safe snapshot
         out.update(self._phases.as_dict())
+        if self._workers is not None:
+            out["cluster"] = self._workers.cluster_view()
         return out
 
 
@@ -271,8 +318,15 @@ class DistributedNetwork:
         self.master = training_master
 
     def fit(self, iterator, epochs: int = 1):
-        for _ in range(epochs):
-            self.master.execute_training(self.net, iterator)
+        try:
+            for _ in range(epochs):
+                self.master.execute_training(self.net, iterator)
+        except Exception as e:
+            # leave the same diagnosis artifact a hang would (flight
+            # events + live spans + registry), then re-raise
+            crash_dump("fit_exception",
+                       master=type(self.master).__name__, error=repr(e))
+            raise
         return self.net
 
     def evaluate(self, iterator, evaluation=None):

@@ -30,6 +30,9 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder, step_guard,
+)
 from deeplearning4j_tpu.observability.servingmetrics import ServingMetrics
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController, DeadlineExceededError, QueueFullError, Request,
@@ -144,8 +147,10 @@ class ServingEngine:
         t0 = time.perf_counter()
         try:
             self.batcher.submit(req)
-        except ServingError:
+        except ServingError as e:
             self.metrics.requests.inc(status="shed")
+            get_flight_recorder().record("shed", model=model,
+                                         reason=type(e).__name__)
             raise
         # +grace so the queue-side deadline purge (which produces the more
         # informative error and owns shed{reason="deadline"}) normally
@@ -156,6 +161,8 @@ class ServingEngine:
             # prefer its result so the shed counter is bumped exactly once
             if not req.done.is_set():
                 self.metrics.requests.inc(status="deadline")
+                get_flight_recorder().record("shed", model=model,
+                                             reason="deadline")
                 raise DeadlineExceededError(
                     f"no result within {deadline:.3f}s deadline "
                     f"(dispatcher dead or engine overloaded)")
@@ -202,6 +209,9 @@ class ServingEngine:
                 except NoWarmupShapeError as e:
                     logger.warning("deploying %s unwarmed: %s", mv.key, e)
             old = self.models.activate(mv)
+            get_flight_recorder().record(
+                "swap", model=name, version=mv.version,
+                replaced=old.version if old else None)
             if old is not None:
                 self.metrics.swaps.inc(model=name)
                 if not self.models.retire(old, timeout=drain_timeout):
@@ -231,6 +241,11 @@ class ServingEngine:
         the row budget, pad each chunk UP to its bucket (never to full
         ``max_batch`` unless needed), fingerprint through the version's
         recompile detector, slice the padding back off."""
+        with step_guard("serving_dispatch", model=model_name,
+                        rows=len(feats)):
+            return self._execute_leased(model_name, feats)
+
+    def _execute_leased(self, model_name: str, feats: np.ndarray) -> np.ndarray:
         with self.models.lease(model_name) as mv:
             n = len(feats)
             outs = []

@@ -21,6 +21,9 @@ from typing import Callable, Optional
 import numpy as np
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.observability.health import (
+    HealthEvaluator, HealthRule, default_serving_rules,
+)
 from deeplearning4j_tpu.serving import (
     ServingEngine, ServingError, ShuttingDownError,
 )
@@ -41,7 +44,14 @@ class InferenceServer:
       request joins the engine's bucketed micro-batches.  Malformed
       bodies get a structured 400; shed requests 429; shutdown 503;
       deadline expiry 504; model errors 400.
-    - ``GET /healthz`` — liveness (includes dispatcher state).
+    - ``GET /healthz`` — LIVENESS: cheap dispatcher-thread check, 503
+      only when it is dead (a busy-but-working instance must not get
+      restarted; no SLO rules evaluated on this path).
+    - ``GET /health`` — READINESS/alerting: the full SLO verdict, every
+      rule with its observed value, limit, and pass/fail; 503 when any
+      rule fails.  Rules default to dispatcher liveness + queue-depth +
+      recompile budget; pass ``health_rules=`` for custom SLOs
+      (``observability.health.HealthRule``).
     - ``GET /metrics`` — Prometheus scrape of the metrics registry.
     - ``GET /models`` — engine/model-registry state (versions, queue).
     - ``POST /models/<name>`` — hot-swap: body ``{"path": <checkpoint>}``
@@ -56,7 +66,8 @@ class InferenceServer:
                  max_wait_ms: float = 2.0, port: int = 0, registry=None,
                  max_queue: int = 256, deadline_s: float = 30.0,
                  example: Optional[np.ndarray] = None,
-                 engine: Optional[ServingEngine] = None):
+                 engine: Optional[ServingEngine] = None,
+                 health_rules=None):
         if engine is None:
             if model is None:
                 raise ValueError("InferenceServer needs a model or an engine")
@@ -79,6 +90,20 @@ class InferenceServer:
         self.max_batch = engine.policy.max_batch
         self.max_wait_ms = engine.batcher.max_wait_s * 1000.0
         self.registry = engine.metrics.registry
+        # SLO-driven health: the binary healthz is now a summary of this
+        # evaluator's verdict.  The dispatcher-liveness predicate needs
+        # the engine object, so it is appended here rather than in
+        # default_serving_rules.
+        rules = list(health_rules) if health_rules is not None else (
+            default_serving_rules(
+                max_queue_depth=max(1.0, 0.9 * engine.admission.max_queue)))
+        rules.append(HealthRule(
+            "dispatcher_alive", "predicate",
+            fn=lambda eng: (eng.batcher.is_alive(),
+                            eng.batcher.is_alive(),
+                            "micro-batch dispatcher thread liveness")))
+        self.health = HealthEvaluator(rules, component="serving",
+                                      registry=self.registry)
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
 
@@ -117,13 +142,23 @@ class InferenceServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    # a dead dispatcher can only time requests out — fail
-                    # the probe so load balancers evict this instance
+                    # LIVENESS probe: cheap and binary — fails only on a
+                    # dead dispatcher (an instance at its queue budget is
+                    # busy, not dead, and restarting busy instances under
+                    # load cascades).  Load balancers hit this every few
+                    # seconds, so no rule evaluation happens here; the
+                    # SLO verdict lives on /health.
                     alive = server.engine.batcher.is_alive()
                     self._json({
                         "status": "ok" if alive else "unavailable",
                         "dispatcher_alive": alive,
                     }, code=200 if alive else 503)
+                elif self.path == "/health":
+                    # the detailed verdict: every rule with observed vs
+                    # limit — the "which SLO is violated" answer
+                    verdict = server.health.evaluate(extra=server.engine)
+                    self._json(verdict.to_dict(),
+                               code=200 if verdict.healthy else 503)
                 elif self.path == "/metrics":
                     body = server.registry.to_prometheus().encode()
                     self.send_response(200)

@@ -19,6 +19,10 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from deeplearning4j_tpu.observability.health import (
+    HealthEvaluator, default_training_rules,
+)
+from deeplearning4j_tpu.observability.metrics import get_registry
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.ui.stats import StatsReport, StatsUpdateConfiguration
 from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
@@ -83,10 +87,27 @@ refresh(); setInterval(refresh, 3000);
 
 
 class UIServer:
-    """≙ ``UiServer.java``: hosts the dashboard + REST + /collect ingest."""
+    """≙ ``UiServer.java``: hosts the dashboard + REST + /collect ingest.
 
-    def __init__(self, storage: Optional[StatsStorage] = None, port: int = 0):
+    Operational endpoints (a training process embedding this server is
+    scrape- and probe-able without a separate exporter):
+
+    - ``GET /metrics`` — Prometheus text scrape of the process-wide
+      metrics registry (fit/phase/compile/worker families).
+    - ``GET /health`` — SLO verdict from a ``HealthEvaluator``
+      (``health=`` to customize; defaults to ``default_training_rules()``:
+      a recompile budget, plus whatever step-p99/throughput/straggler
+      limits the caller configures); 200 healthy / 503 with the failing
+      rules detailed.
+    """
+
+    def __init__(self, storage: Optional[StatsStorage] = None, port: int = 0,
+                 registry=None, health: Optional[HealthEvaluator] = None):
         self.storage = storage or InMemoryStatsStorage()
+        self._registry = registry
+        self.health = health or HealthEvaluator(
+            default_training_rules(), component="training",
+            registry=registry)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._requested_port = port
@@ -94,6 +115,7 @@ class UIServer:
     # ------------------------------------------------------------ lifecycle
     def start(self) -> int:
         storage = self.storage
+        ui = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
@@ -135,6 +157,21 @@ class UIServer:
                     sid = params.get("sid")
                     ups = storage.get_updates(sid) if sid else []
                     self._json([u.memory for u in ups])
+                elif path == "/metrics":
+                    reg = (ui._registry if ui._registry is not None
+                           else get_registry())
+                    body = reg.to_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/health":
+                    verdict = ui.health.evaluate()
+                    self._json(verdict.to_dict(),
+                               code=200 if verdict.healthy else 503)
                 else:
                     self._json({"error": "not found", "path": path}, 404)
 

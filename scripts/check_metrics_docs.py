@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Lint: every registered metric family has help text and a docs row.
+
+Walks the ``deeplearning4j_tpu`` package (plus ``bench.py``) with ``ast``
+looking for registry family registrations — ``.counter(...)``,
+``.gauge(...)``, ``.histogram(...)`` calls whose first argument is a
+string literal starting with ``dl4j_`` — and enforces two invariants:
+
+1. the registration passes a NON-EMPTY help string (literal second
+   positional argument or ``help=``) in at least one site — /metrics
+   output without HELP lines is useless to an operator;
+2. the family name appears in a table row (a line starting with ``|``)
+   of ``docs/observability.md`` — the docs table is the metric
+   catalogue, and a family that never made it there is invisible.
+
+No imports of the package (and no jax) — the scan is pure source
+analysis, so it runs in milliseconds and can't be defeated by lazy
+registration.  Wired into the tier-1 suite via
+``tests/test_metrics_docs.py``; run standalone with
+``python scripts/check_metrics_docs.py`` (exit 0 = clean).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Set, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "deeplearning4j_tpu")
+EXTRA_FILES = [os.path.join(REPO, "bench.py")]
+DOCS = os.path.join(REPO, "docs", "observability.md")
+
+_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _iter_py_files():
+    for root, _dirs, files in os.walk(PACKAGE):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(root, f)
+    for f in EXTRA_FILES:
+        if os.path.exists(f):
+            yield f
+
+
+def _literal_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def find_registrations() -> Dict[str, List[Tuple[str, int, bool]]]:
+    """family name -> [(file, line, has_help)] across the codebase."""
+    out: Dict[str, List[Tuple[str, int, bool]]] = {}
+    for path in _iter_py_files():
+        with open(path) as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:   # pragma: no cover - would fail tests too
+            print(f"{path}: unparsable: {e}", file=sys.stderr)
+            continue
+        rel = os.path.relpath(path, REPO)
+        # module-level string constants (the owning modules name their
+        # families via _FAMILY = "dl4j_..." so they register in one place)
+        consts: Dict[str, str] = {}
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and (s := _literal_str(node.value)) is not None):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        consts[tgt.id] = s
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _METHODS and node.args):
+                continue
+            arg0 = node.args[0]
+            name = _literal_str(arg0)
+            if name is None and isinstance(arg0, ast.Name):
+                name = consts.get(arg0.id)
+            if not name or not name.startswith("dl4j_"):
+                continue
+            help_text = None
+            if len(node.args) > 1:
+                help_text = _literal_str(node.args[1])
+            for kw in node.keywords:
+                if kw.arg == "help":
+                    help_text = _literal_str(kw.value)
+            # adjacent string literals concatenate into one Constant, so a
+            # multi-line help renders as a single (truthy) literal here
+            has_help = bool(help_text and help_text.strip())
+            out.setdefault(name, []).append((rel, node.lineno, has_help))
+    return out
+
+
+def documented_families() -> Set[str]:
+    """dl4j_* names appearing in table rows of docs/observability.md."""
+    names: Set[str] = set()
+    with open(DOCS) as f:
+        for line in f:
+            if not line.lstrip().startswith("|"):
+                continue
+            for tok in line.replace("`", " ").replace("|", " ").split():
+                tok = tok.strip("*,.()/")
+                if tok.startswith("dl4j_"):
+                    names.add(tok)
+    return names
+
+
+def run_lint() -> List[str]:
+    """Returns a list of violations (empty = clean)."""
+    problems: List[str] = []
+    regs = find_registrations()
+    if not regs:
+        return ["no dl4j_* metric registrations found — scanner broken?"]
+    docs = documented_families()
+    for name, sites in sorted(regs.items()):
+        if not any(has_help for _f, _l, has_help in sites):
+            where = ", ".join(f"{f}:{l}" for f, l, _ in sites[:3])
+            problems.append(
+                f"{name}: registered without non-empty help text ({where})")
+        if name not in docs:
+            problems.append(
+                f"{name}: no row in docs/observability.md metric table")
+    return problems
+
+
+def main() -> int:
+    problems = run_lint()
+    for p in problems:
+        print(f"check_metrics_docs: {p}", file=sys.stderr)
+    if not problems:
+        n = len(find_registrations())
+        print(f"check_metrics_docs: OK ({n} dl4j_* families documented)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Tier-1 lint: every registered dl4j_* metric family has non-empty help
+text and a row in the docs/observability.md metric table
+(scripts/check_metrics_docs.py — pure source analysis, no jax)."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_linter():
+    path = os.path.join(REPO, "scripts", "check_metrics_docs.py")
+    spec = importlib.util.spec_from_file_location("check_metrics_docs", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_metric_family_has_help_and_docs_row():
+    mod = _load_linter()
+    problems = mod.run_lint()
+    assert problems == [], "\n".join(problems)
+
+
+def test_scanner_sees_known_families():
+    """Guard against the scanner silently matching nothing (which would
+    make the lint above vacuously green)."""
+    mod = _load_linter()
+    regs = mod.find_registrations()
+    for expected in ("dl4j_fit_step_seconds", "dl4j_worker_step_seconds",
+                     "dl4j_stragglers_total", "dl4j_serving_requests_total",
+                     "dl4j_health_status", "dl4j_watchdog_dumps_total",
+                     "dl4j_phase_seconds"):
+        assert expected in regs, f"scanner missed {expected}"
+    docs = mod.documented_families()
+    assert "dl4j_fit_step_seconds" in docs
