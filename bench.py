@@ -796,6 +796,78 @@ def bench_serving(platform, peak):
     }
 
 
+def bench_checkpoint(platform, peak):
+    """Resilience-layer cost on record: checkpoint save throughput (MB/s
+    through snapshot + serialize + fsync + atomic commit), restore
+    latency, and end-to-end resume latency (discover newest valid commit
+    -> restore params/updater/RNG/iteration into a fresh facade)."""
+    import shutil
+    import tempfile
+
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.resilience import CheckpointManager
+
+    hidden = 512
+    conf = (NeuralNetConfiguration.builder().seed(12345)
+            .updater("adam", learning_rate=0.01).list()
+            .layer(DenseLayer(n_in=256, n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+            .layer(DenseLayer(n_in=hidden, n_out=hidden, activation="relu"))
+            .layer(OutputLayer(n_in=hidden, n_out=10, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(0)
+    net.fit(rs.rand(32, 256).astype(np.float32),
+            np.eye(10, dtype=np.float32)[rs.randint(0, 10, 32)])
+
+    root = tempfile.mkdtemp(prefix="dl4j-bench-ckpt-")
+    try:
+        cm = CheckpointManager(root, keep=3, async_save=False)
+        reps, save_s, nbytes = 5, [], 0
+        for r in range(reps):
+            net.iteration = r + 1    # distinct steps: same-step saves no-op
+            t0 = time.perf_counter()
+            job = cm.save(net)
+            save_s.append(time.perf_counter() - t0)
+            nbytes = job.bytes or nbytes
+        mb = nbytes / 1e6
+        save_mbps = mb / (sum(save_s) / len(save_s))
+
+        restore_s = []
+        for _ in range(3):
+            fresh = MultiLayerNetwork(conf).init()
+            t0 = time.perf_counter()
+            cm.restore(fresh)
+            restore_s.append(time.perf_counter() - t0)
+
+        # resume latency: what a replacement VM pays before its first step
+        # (validate commits newest-first incl. CRCs, then restore)
+        fresh = MultiLayerNetwork(conf).init()
+        t0 = time.perf_counter()
+        resumed_to = cm.resume(fresh)
+        resume_ms = (time.perf_counter() - t0) * 1e3
+        assert resumed_to == reps
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "metric": (f"Checkpoint save throughput ({mb:.1f} MB snapshot, "
+                   f"atomic commit + fsync)"),
+        "value": round(save_mbps, 1),
+        "unit": "MB/s",
+        "vs_baseline": None,   # reference has no checkpoint-throughput bench
+        "data": "synthetic",
+        "dtype": "float32",
+        "checkpoint_mb": round(mb, 2),
+        "save_ms_mean": round(1e3 * sum(save_s) / len(save_s), 2),
+        "restore_ms_mean": round(1e3 * sum(restore_s) / len(restore_s), 2),
+        "resume_latency_ms": round(resume_ms, 2),
+    }
+
+
 def main():
     baselines = _load_baselines()
     devices = _devices_with_retry()
@@ -818,7 +890,8 @@ def main():
             ("transformer", lambda: bench_transformer(platform, baselines, peak)),
             ("decode", lambda: bench_decode(platform, peak)),
             ("long_context", lambda: bench_long_context(platform, peak)),
-            ("serving", lambda: bench_serving(platform, peak))):
+            ("serving", lambda: bench_serving(platform, peak)),
+            ("checkpoint", lambda: bench_checkpoint(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

@@ -13,11 +13,15 @@ prefetch thread keeps the input pipeline off the critical path.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
+import time
 from typing import Iterator, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu.datasets")
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 
@@ -278,9 +282,11 @@ class AsyncDataSetIterator(DataSetIterator):
     producer thread).  Wraps any DataSetIterator; ``fit`` wraps its input in
     this automatically like the reference's ``fit(DataSetIterator)`` :1032."""
 
-    def __init__(self, underlying: DataSetIterator, prefetch_size: int = 2):
+    def __init__(self, underlying: DataSetIterator, prefetch_size: int = 2,
+                 reset_timeout_s: float = 5.0):
         self.underlying = underlying
         self.prefetch = prefetch_size
+        self.reset_timeout_s = float(reset_timeout_s)
         self._queue: "queue.Queue" = queue.Queue(maxsize=prefetch_size)
         self._thread: Optional[threading.Thread] = None
         self._next_item = _SENTINEL
@@ -324,10 +330,36 @@ class AsyncDataSetIterator(DataSetIterator):
 
     def reset(self):
         if self._thread is not None and self._thread.is_alive():
-            # drain so the producer can finish
+            # drain (bounded) so the producer can finish, then join.  A
+            # producer that makes NO progress for a whole timeout window is
+            # stuck inside ``underlying.next()`` — starting a second
+            # producer over the same underlying iterator would race it
+            # (two threads advancing one iterator = interleaved/dropped
+            # batches), so hard-fail instead of silently abandoning the
+            # old thread.  Each drained item re-arms the deadline: a
+            # merely SLOW producer (heavy per-batch preprocessing) gets a
+            # full window per batch, not one window for the whole drain.
+            deadline = time.monotonic() + self.reset_timeout_s
             while self._next_item is not _SENTINEL:
-                self._next_item = self._queue.get()
-            self._thread.join(timeout=5)
+                try:
+                    self._next_item = self._queue.get(
+                        timeout=max(0.05, deadline - time.monotonic()))
+                except queue.Empty:
+                    break
+                deadline = time.monotonic() + self.reset_timeout_s
+            self._thread.join(timeout=max(0.05,
+                                          deadline - time.monotonic()))
+            if self._thread.is_alive():
+                logger.error(
+                    "AsyncDataSetIterator.reset: producer thread still "
+                    "alive after %.1fs drain+join — refusing to start a "
+                    "second producer over the same underlying iterator",
+                    self.reset_timeout_s)
+                raise RuntimeError(
+                    "AsyncDataSetIterator.reset: prefetch producer did not "
+                    f"stop within {self.reset_timeout_s}s (stuck in "
+                    "underlying.next()?); a second producer would race the "
+                    "live one on the underlying iterator")
         self.underlying.reset()
         self._start()
 

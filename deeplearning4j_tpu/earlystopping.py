@@ -199,13 +199,20 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
     def latest_path(self) -> str:
         return os.path.join(self.directory, "latestModel.zip")
 
+    def _save_atomic(self, net, path: str) -> None:
+        # stage + rename: a crash mid-write must never tear the PREVIOUS
+        # best/latest model (the reference rewrites the zip in place)
+        tmp = path + ".tmp"
+        net.save(tmp)
+        os.replace(tmp, path)
+
     def save_best_model(self, net, score: float) -> None:
         self._model_cls = self._model_cls or type(net)
-        net.save(self.best_path)
+        self._save_atomic(net, self.best_path)
 
     def save_latest_model(self, net, score: float) -> None:
         self._model_cls = self._model_cls or type(net)
-        net.save(self.latest_path)
+        self._save_atomic(net, self.latest_path)
 
     def _load(self, path):
         if self._model_cls is not None:
@@ -223,6 +230,52 @@ class LocalFileModelSaver(EarlyStoppingModelSaver):
         if not os.path.exists(self.latest_path):
             return None
         return self._load(self.latest_path)
+
+
+class CheckpointModelSaver(EarlyStoppingModelSaver):
+    """Model saving routed through ``resilience.CheckpointManager``: every
+    best/latest save commits atomically (tmp -> fsync -> rename + COMMIT)
+    and retention is bounded to ``keep`` checkpoints per track — replacing
+    ad-hoc ``save_checkpoint`` call sites that wrote non-atomically into a
+    live directory and retained forever.  ``get_*_model`` restores into a
+    clone of the last-saved net (params, updater state, RNG stream and
+    iteration all come from the checkpoint), so a crash between epochs
+    loses at most the uncommitted epoch."""
+
+    def __init__(self, directory: str, keep: int = 2):
+        from deeplearning4j_tpu.resilience import CheckpointManager
+
+        self.directory = directory
+        # synchronous managers: an early-stopping epoch boundary is not a
+        # hot loop, and the trainer reads the model back immediately
+        self._best = CheckpointManager(
+            os.path.join(directory, "best"), keep=keep, async_save=False,
+            auto_resume=False)
+        self._latest = CheckpointManager(
+            os.path.join(directory, "latest"), keep=keep, async_save=False,
+            auto_resume=False)
+        self._template = None
+
+    def save_best_model(self, net, score: float) -> None:
+        self._template = net
+        self._best.save(net, trigger="best")
+
+    def save_latest_model(self, net, score: float) -> None:
+        self._template = net
+        self._latest.save(net, trigger="latest")
+
+    def _restore_from(self, manager):
+        if self._template is None or manager.latest() is None:
+            return None
+        model = self._template.clone()
+        manager.restore(model)
+        return model
+
+    def get_best_model(self):
+        return self._restore_from(self._best)
+
+    def get_latest_model(self):
+        return self._restore_from(self._latest)
 
 
 # ---------------------------------------------------------------------------

@@ -585,27 +585,59 @@ class ComputationGraph(LazyScoreMixin):
             for x, y in window:
                 self._one_step(x, y, None, None, carries=None)
 
-    def fit(self, data, labels=None, *, fmask=None, lmask=None):
+    def fit(self, data, labels=None, *, fmask=None, lmask=None,
+            checkpoint_manager=None, retry_policy=None):
         """fit(inputs, labels) or fit(iterable of DataSet / MultiDataSet /
         tuples).  MultiDataSet features/labels map positionally onto
         ``conf.inputs`` / ``conf.outputs`` (reference
-        ``ComputationGraph.fit(MultiDataSetIterator)`` :599-747)."""
+        ``ComputationGraph.fit(MultiDataSetIterator)`` :599-747).
+
+        ``checkpoint_manager=`` / ``retry_policy=`` wire the resilience
+        layer exactly as in ``MultiLayerNetwork.fit``: auto-resume with
+        batch skipping, boundary saves, clean preemption stop, transient
+        step retry (docs/resilience.md)."""
+        res = None
+        if checkpoint_manager is not None or retry_policy is not None:
+            from deeplearning4j_tpu.resilience import FitResilience
+
+            res = FitResilience("ComputationGraph", checkpoint_manager,
+                                retry_policy, net=self)
+        from deeplearning4j_tpu.resilience import preemption_requested
+
         try:
             if labels is not None:
-                self._fit_one(data, labels, fmask, lmask)
+                # the single-pair path is one "batch": same skip /
+                # preemption / boundary-save duties as the iterable loop
+                # (user-driven loops call fit(x, y) repeatedly)
+                if res is not None and res.skip_window(self._batch_adv(data)):
+                    return self
+                if preemption_requested():
+                    if res is not None:
+                        res.on_preempt(self)
+                    return self
+                self._fit_one(data, labels, fmask, lmask, res)
+                if res is not None:
+                    res.after_step(self)
                 return self
             for batch in data:
                 if hasattr(batch, "features_masks"):  # MultiDataSet
                     x, y, fm, lm = self._unpack_multi(batch)
-                    self._fit_one(x, y, fm, lm)
                 elif hasattr(batch, "features"):
-                    self._fit_one(batch.features, batch.labels,
-                                  batch.features_mask, batch.labels_mask)
+                    x, y, fm, lm = (batch.features, batch.labels,
+                                    batch.features_mask, batch.labels_mask)
                 else:
                     x, y = batch[0], batch[1]
                     fm = batch[2] if len(batch) > 2 else None
                     lm = batch[3] if len(batch) > 3 else None
-                    self._fit_one(x, y, fm, lm)
+                if res is not None and res.skip_window(self._batch_adv(x)):
+                    continue   # auto-resume: batch covered by the ckpt
+                if preemption_requested():
+                    if res is not None:
+                        res.on_preempt(self)
+                    break   # preemption: stop cleanly at a boundary
+                self._fit_one(x, y, fm, lm, res)
+                if res is not None:
+                    res.after_step(self)
         except Exception as e:
             # fit-loop exception: leave the same flight-recorder report a
             # hang would (events + live spans + registry snapshot)
@@ -638,12 +670,36 @@ class ComputationGraph(LazyScoreMixin):
                   if m is not None} or None
         return x, y, fm, lm
 
-    def _fit_one(self, x, y, fm, lm):
+    def _batch_adv(self, x) -> int:
+        """How many ITERATIONS one batch advances — the resume-skip unit.
+        1 everywhere except SGD TBPTT, where one batch runs one iteration
+        per fwd-length window (the solver path also advances by exactly 1,
+        after the solve)."""
+        if (self.conf.optimization_algo == "stochastic_gradient_descent"
+                and self.conf.backprop_type == "truncated_bptt"):
+            temporal = [np.shape(a)[1]
+                        for a in self._as_input_dict(x).values()
+                        if np.ndim(a) >= 3]
+            if temporal:
+                return -(-max(temporal) // self.conf.tbptt_fwd_length)
+        return 1
+
+    def _fit_one(self, x, y, fm, lm, res=None):
+        """One batch; the resilience retry scope is per ITERATION — the
+        single SGD step, each TBPTT window, or the whole solver solve
+        (which only writes params/iteration after it finishes)."""
         if self.conf.optimization_algo != "stochastic_gradient_descent":
+            if res is not None:
+                return res.step(lambda: self._fit_solver(x, y, fm, lm),
+                                self.iteration, net=self)
             return self._fit_solver(x, y, fm, lm)
         if self.conf.backprop_type == "truncated_bptt":
-            return self._fit_tbptt(x, y, fm, lm)
-        self._one_step(x, y, fm, lm, carries=None)
+            return self._fit_tbptt(x, y, fm, lm, res)
+        if res is not None:
+            res.step(lambda: self._one_step(x, y, fm, lm, carries=None),
+                     self.iteration, net=self)
+        else:
+            self._one_step(x, y, fm, lm, carries=None)
 
     def _one_step(self, x, y, fm, lm, carries):
         step = self._get_train_step()
@@ -672,11 +728,12 @@ class ComputationGraph(LazyScoreMixin):
         notify_listeners(self, batch)
         return new_carries
 
-    def _fit_tbptt(self, x, y, fm, lm):
+    def _fit_tbptt(self, x, y, fm, lm, res=None):
         """Truncated BPTT over the DAG: slice the time axis of every input/
         label/mask into fwd-length windows, carrying recurrent-node state
         (detached) across windows (reference ``ComputationGraph``
-        ``doTruncatedBPTT`` :1549)."""
+        ``doTruncatedBPTT`` :1549).  Retry scope is per WINDOW — each
+        window is one committed iteration."""
         x = self._as_input_dict(x)
         y = self._as_label_dict(y)
         temporal = [a.shape[1] for a in x.values() if np.ndim(a) >= 3]
@@ -689,11 +746,20 @@ class ComputationGraph(LazyScoreMixin):
         carries = None
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
-            carries = self._one_step(
-                self._tbptt_slice_data(x, sl), self._tbptt_slice_data(y, sl),
-                self._tbptt_slice_mask(fm, sl), self._tbptt_slice_mask(lm, sl),
-                carries,
-            )
+
+            def one_window(c=carries, sl=sl):
+                return self._one_step(
+                    self._tbptt_slice_data(x, sl),
+                    self._tbptt_slice_data(y, sl),
+                    self._tbptt_slice_mask(fm, sl),
+                    self._tbptt_slice_mask(lm, sl),
+                    c,
+                )
+
+            if res is not None:
+                carries = res.step(one_window, self.iteration, net=self)
+            else:
+                carries = one_window()
             carries = jax.lax.stop_gradient(carries)
 
     @staticmethod

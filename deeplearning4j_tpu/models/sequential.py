@@ -320,17 +320,33 @@ class MultiLayerNetwork(LazyScoreMixin):
         return self._jit_cache[key]
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, *, fmask=None, lmask=None, epochs: int = 1):
+    def fit(self, data, labels=None, *, fmask=None, lmask=None,
+            epochs: int = 1, checkpoint_manager=None, retry_policy=None):
         """Train.  ``data`` is a DataSetIterator-style iterable of
         (features, labels[, fmask, lmask]) tuples, or a single (X, y) pair.
-        Reference: ``MultiLayerNetwork.fit(DataSetIterator)`` :1029."""
+        Reference: ``MultiLayerNetwork.fit(DataSetIterator)`` :1029.
+
+        With ``checkpoint_manager=`` the loop auto-resumes from the newest
+        committed checkpoint (params/updater/RNG/iteration restored, the
+        already-consumed batches skipped), saves on the manager's triggers
+        at step boundaries, and — on SIGTERM/SIGINT via an installed
+        ``PreemptionHandler`` — commits a priority checkpoint and returns
+        cleanly.  ``retry_policy=`` retries transient step failures with
+        backoff (docs/resilience.md)."""
+        res = None
+        if checkpoint_manager is not None or retry_policy is not None:
+            from deeplearning4j_tpu.resilience import FitResilience
+
+            res = FitResilience("MultiLayerNetwork", checkpoint_manager,
+                                retry_policy, net=self)
         try:
             if labels is not None:
                 batches = [(data, labels, fmask, lmask)]
-                self._fit_batches(batches)
+                self._fit_batches(batches, res)
                 return self
             for _ in range(epochs):
-                self._fit_batches(data)
+                if self._fit_batches(data, res):
+                    break   # preemption: stopped cleanly at a boundary
         except Exception as e:
             # fit-loop exception: leave the same flight-recorder report a
             # hang would (events + live spans + registry snapshot)
@@ -339,21 +355,57 @@ class MultiLayerNetwork(LazyScoreMixin):
             raise
         return self
 
-    def _fit_batches(self, batches):
+    def _fit_batches(self, batches, res=None) -> bool:
+        """One pass; returns True when preemption stopped the loop."""
+        from deeplearning4j_tpu.resilience import preemption_requested
+
         if self.conf.optimization_algo != "stochastic_gradient_descent":
             for batch in batches:
+                # the solver writes params/score and advances the iteration
+                # by exactly 1 per batch, all AFTER the solve — so skip is
+                # per batch and a whole-batch retry is state-safe
+                if res is not None and res.skip_batch():
+                    continue
+                if preemption_requested():
+                    if res is not None:
+                        res.on_preempt(self)
+                    return True
                 x, y, fm, lm = self._unpack(batch)
-                self._fit_solver(x, y, fm, lm)
-            return
+                if res is not None:
+                    res.step(lambda: self._fit_solver(x, y, fm, lm),
+                             self.iteration, net=self)
+                    res.after_step(self)
+                else:
+                    self._fit_solver(x, y, fm, lm)
+            return False
         step = self._get_train_step()
         tbptt = self.conf.backprop_type == "truncated_bptt"
+        L = self.conf.tbptt_fwd_length
         for batch in batches:
             x, y, fm, lm = self._unpack(batch)
+            if res is not None:
+                # skip is counted in ITERATIONS: one batch advances by
+                # num_iterations, times the TBPTT window count for
+                # sequence fits
+                windows = -(-int(np.shape(x)[1]) // L) if tbptt else 1
+                if res.skip_window(self.conf.num_iterations * windows):
+                    continue
+            if preemption_requested():
+                if res is not None:
+                    res.on_preempt(self)
+                return True
             for _ in range(self.conf.num_iterations):
                 if tbptt:
-                    self._fit_tbptt(step, x, y, fm, lm)
+                    self._fit_tbptt(step, x, y, fm, lm, res)
+                elif res is not None:
+                    res.step(lambda: self._one_step(
+                        step, x, y, fm, lm, carries=None),
+                        self.iteration, net=self)
                 else:
                     self._one_step(step, x, y, fm, lm, carries=None)
+            if res is not None:
+                res.after_step(self)
+        return False
 
     def _fit_solver(self, x, y, fm, lm):
         """Full-batch solver path (CG/LBFGS/line-search GD) over the flat
@@ -398,21 +450,32 @@ class MultiLayerNetwork(LazyScoreMixin):
         notify_listeners(self, int(np.shape(x)[0]))
         return new_carries
 
-    def _fit_tbptt(self, step, x, y, fm, lm):
+    def _fit_tbptt(self, step, x, y, fm, lm, res=None):
         """Truncated BPTT: slice the time axis into fwd-length windows,
         carrying RNN state (detached) across windows.
-        Reference ``doTruncatedBPTT`` ``MultiLayerNetwork.java:1176``."""
+        Reference ``doTruncatedBPTT`` ``MultiLayerNetwork.java:1176``.
+
+        The resilience retry scope is per WINDOW (each window is one
+        iteration that already updated params — retrying a whole batch
+        would replay committed windows)."""
         T = x.shape[1]
         L = self.conf.tbptt_fwd_length
         carries = None
         for t0 in range(0, T, L):
             sl = slice(t0, min(t0 + L, T))
-            carries = self._one_step(
-                step, x[:, sl], y[:, sl],
-                None if fm is None else fm[:, sl],
-                None if lm is None else lm[:, sl],
-                carries,
-            )
+
+            def one_window(c=carries, sl=sl):
+                return self._one_step(
+                    step, x[:, sl], y[:, sl],
+                    None if fm is None else fm[:, sl],
+                    None if lm is None else lm[:, sl],
+                    c,
+                )
+
+            if res is not None:
+                carries = res.step(one_window, self.iteration, net=self)
+            else:
+                carries = one_window()
             carries = jax.lax.stop_gradient(carries)
 
     @staticmethod

@@ -356,6 +356,11 @@ class HealthRule:
       must be <= ``limit``
     - ``max_stragglers`` — summed counter (``dl4j_stragglers_total``)
       must be <= ``limit``
+    - ``max_checkpoint_staleness`` — max gauge child
+      (``dl4j_checkpoint_staleness_seconds``) must be <= ``limit``
+      seconds: flags a run whose CheckpointManager stopped committing
+      (or never started) long before the lost progress is discovered
+      the hard way
     - ``predicate`` — ``fn(extra) -> bool`` (or ``(ok, observed, detail)``)
       for liveness checks that live outside the registry
 
@@ -370,6 +375,7 @@ class HealthRule:
         "min_throughput": "dl4j_fit_samples_per_second",
         "max_recompiles": "dl4j_recompiles_total",
         "max_stragglers": "dl4j_stragglers_total",
+        "max_checkpoint_staleness": "dl4j_checkpoint_staleness_seconds",
     }
 
     def __init__(self, name: str, kind: str, limit: Optional[float] = None,
@@ -415,19 +421,21 @@ class HealthRule:
                 return None, "no step samples yet"
             v, labels = max(vals, key=lambda t: t[0])
             return v, f"worst child: {labels or 'unlabeled'}"
-        if self.kind in ("max_queue_depth", "min_throughput"):
+        if self.kind in ("max_queue_depth", "min_throughput",
+                         "max_checkpoint_staleness"):
             vals = [(c.value, labels) for labels, c in children]
             vals = [(v, l) for v, l in vals if not math.isnan(v)]
             if not vals:
                 return None, "no gauge children yet"
-            # both kinds take the MAX child: deepest queue for the depth
-            # cap, and the best current throughput for the floor — a
-            # stale low gauge from a finished side model must not fail
-            # the floor forever (narrow the rule with labels= to watch
-            # one specific child)
+            # all three kinds take the MAX child: deepest queue for the
+            # depth cap, best current throughput for the floor (a stale
+            # low gauge from a finished side model must not fail the
+            # floor forever — narrow with labels= to watch one child),
+            # and the stalest checkpoint manager for the staleness cap
             v, labels = max(vals, key=lambda t: t[0])
-            which = ("deepest" if self.kind == "max_queue_depth"
-                     else "best")
+            which = {"max_queue_depth": "deepest",
+                     "min_throughput": "best",
+                     "max_checkpoint_staleness": "stalest"}[self.kind]
             return v, f"{which} child: {labels or 'unlabeled'}"
         # counters: sum over matching children
         if not children:
@@ -507,11 +515,14 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
                            min_samples_per_sec: Optional[float] = None,
                            max_recompiles: float = 100.0,
                            max_stragglers: Optional[float] = None,
+                           max_checkpoint_staleness_s: Optional[float] = None,
                            ) -> List[HealthRule]:
     """Sensible defaults for a training process: an optional step-time
     SLO, an optional throughput floor, a recompile budget (steady-state
     shape churn is the classic silent TPU throughput bug), an optional
-    straggler budget."""
+    straggler budget, an optional checkpoint-staleness cap (a run whose
+    CheckpointManager stopped committing fails /health while the progress
+    is still recoverable — docs/resilience.md)."""
     rules = [HealthRule("recompile_budget", "max_recompiles",
                         max_recompiles)]
     if max_step_p99_s is not None:
@@ -522,6 +533,10 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
     if max_stragglers is not None:
         rules.append(HealthRule("straggler_budget", "max_stragglers",
                                 max_stragglers))
+    if max_checkpoint_staleness_s is not None:
+        rules.append(HealthRule("checkpoint_staleness",
+                                "max_checkpoint_staleness",
+                                max_checkpoint_staleness_s))
     return rules
 
 

@@ -22,4 +22,6 @@ from deeplearning4j_tpu.parallel.pipeline import (
 from deeplearning4j_tpu.parallel.checkpoint import (
     save_checkpoint,
     restore_checkpoint,
+    snapshot_trees,
+    write_snapshot,
 )

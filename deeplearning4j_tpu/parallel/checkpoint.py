@@ -90,10 +90,14 @@ def _leaf_spec(leaf) -> list:
 
 
 # ------------------------------------------------------------------------ save
-def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = None) -> None:
-    """Write this process's shards of the facade's params / updater state /
-    net state (or explicit ``trees``) plus iteration + RNG root key."""
-    os.makedirs(directory, exist_ok=True)
+def snapshot_trees(net, *, trees: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Host-side snapshot of this process's shards of the facade's params /
+    updater state / net state (or explicit ``trees``) plus iteration + RNG
+    root key.  This is the device->host half of a save: it walks
+    ``addressable_shards`` and copies every shard to numpy, so it must run
+    on the training thread at a step boundary — but the returned structure
+    is plain host data, safe to hand to a background writer thread
+    (``resilience.CheckpointManager`` does exactly that)."""
     proc = jax.process_index()
     trees = trees if trees is not None else {
         "params": net.params,
@@ -141,10 +145,7 @@ def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = No
                     "key": key,
                     "index": [[0, d] for d in leaf.shape]})
             manifest["leaves"][path] = entry
-    with open(os.path.join(directory, MANIFEST.format(proc=proc)), "w") as f:
-        json.dump(manifest, f)
-    with open(os.path.join(directory, SHARDS.format(proc=proc)), "wb") as f:
-        np.savez(f, **arrays)
+    meta = None
     if proc == 0:
         meta = {
             "format_version": 1,
@@ -155,13 +156,66 @@ def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = No
         if keys is not None:
             meta["rng_key"] = np.asarray(
                 jax.random.key_data(keys._key)).tolist()
-        with open(os.path.join(directory, META), "w") as f:
-            json.dump(meta, f)
+    return {
+        "proc": proc,
+        "manifest": manifest,
+        "arrays": arrays,
+        "meta": meta,
+        "iteration": int(getattr(net, "iteration", 0)),
+    }
+
+
+def write_snapshot(directory: str, snapshot: Dict[str, Any], *,
+                   fsync: bool = False, on_file=None) -> int:
+    """File half of a save: write a ``snapshot_trees`` result into
+    ``directory`` (shards first, then manifest, then meta — the order a
+    torn write is cheapest to detect in).  Pure host IO, safe off the
+    training thread.  ``on_file(path)`` fires after each file lands
+    (the ``FaultInjector`` crash-mid-save hook); with ``fsync`` every file
+    is flushed to disk before the call returns — the atomic-commit rename
+    in ``resilience.CheckpointManager`` relies on that ordering.  Returns
+    total bytes written by this process."""
+    os.makedirs(directory, exist_ok=True)
+    proc = snapshot["proc"]
+    total = 0
+
+    def _land(path, write_fn, mode):
+        nonlocal total
+        with open(path, mode) as f:
+            write_fn(f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        total += os.path.getsize(path)
+        if on_file is not None:
+            on_file(path)
+
+    _land(os.path.join(directory, SHARDS.format(proc=proc)),
+          lambda f: np.savez(f, **snapshot["arrays"]), "wb")
+    _land(os.path.join(directory, MANIFEST.format(proc=proc)),
+          lambda f: json.dump(snapshot["manifest"], f), "w")
+    if snapshot["meta"] is not None:
+        _land(os.path.join(directory, META),
+              lambda f: json.dump(snapshot["meta"], f), "w")
+    return total
+
+
+def save_checkpoint(directory: str, net, *, trees: Optional[Dict[str, Any]] = None) -> None:
+    """Write this process's shards of the facade's params / updater state /
+    net state (or explicit ``trees``) plus iteration + RNG root key.
+
+    NOTE: this low-level call writes straight into the live ``directory``
+    — a crash mid-save leaves a torn checkpoint there.  Production runs
+    should save through ``resilience.CheckpointManager``, which stages the
+    same files in a ``step-N.tmp/`` directory and commits them atomically
+    (tmp -> fsync -> rename + COMMIT manifest)."""
+    snapshot = snapshot_trees(net, trees=trees)
+    write_snapshot(directory, snapshot)
     from deeplearning4j_tpu.observability import get_flight_recorder
 
     get_flight_recorder().record(
-        "checkpoint", directory=str(directory), process=proc,
-        iteration=int(getattr(net, "iteration", 0)))
+        "checkpoint", directory=str(directory), process=snapshot["proc"],
+        iteration=snapshot["iteration"])
 
 
 # --------------------------------------------------------------------- restore

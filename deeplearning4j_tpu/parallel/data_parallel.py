@@ -131,8 +131,14 @@ class ParallelWrapper:
         average_updaters: bool = True,
         mesh: Optional[Mesh] = None,
         collect_worker_stats: bool = False,
+        checkpoint_manager=None,
+        retry_policy=None,
     ):
         self.net = net
+        # resilience wiring (docs/resilience.md): auto-resume on fit entry,
+        # window-boundary saves, clean preemption stop, transient retry
+        self.checkpoint_manager = checkpoint_manager
+        self.retry_policy = retry_policy
         self.mesh = mesh or backend.default_mesh()
         self.workers = workers or self.mesh.shape[backend.AXIS_DATA]
         if self.workers != self.mesh.shape[backend.AXIS_DATA]:
@@ -230,11 +236,20 @@ class ParallelWrapper:
         from deeplearning4j_tpu.datasets.iterator import (
             AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
         )
+        from deeplearning4j_tpu.resilience import (
+            FitResilience, preemption_requested,
+        )
 
         if self._step_fn is None:
             self._build()
 
         net = self.net
+        res = None
+        if self.checkpoint_manager is not None or self.retry_policy is not None:
+            # resume BEFORE replica stacking so the restored params are
+            # what gets broadcast to the K replicas
+            res = FitResilience("parallel_wrapper", self.checkpoint_manager,
+                                self.retry_policy, net=net, mesh=self.mesh)
         K, F = self.workers, self.averaging_frequency
         params_k = _stack_tree(net.params, K)
         upd_k = _stack_tree(net.updater_state, K)
@@ -260,7 +275,7 @@ class ParallelWrapper:
         ).set(K)
         if self.collect_worker_stats and self._workers is None:
             self._workers = WorkerTelemetry("parallel_wrapper")
-        it = net.iteration
+        it0 = it = net.iteration
         last_losses = None
         win_iter = iter(windows)
         while True:
@@ -271,33 +286,65 @@ class ParallelWrapper:
             if win is None:
                 break
             xs, ys, fms, lms, n_batches = win
+            adv = n_batches // K
+            if res is not None and res.skip_window(adv):
+                # auto-resume: consume the window the restored iteration
+                # already covers (it stays put — restore set it past these)
+                continue
+            if preemption_requested():
+                self._fold_back(net, params_k, upd_k, ns_k, it, last_losses)
+                if res is not None:
+                    res.on_preempt(net)
+                if hasattr(windows, "close"):
+                    windows.close()
+                self.iteration = it - it0
+                return net
             t_disp0 = time.perf_counter()
             with step_guard("parallel_window",
                             component="parallel_wrapper", iteration=it):
                 with self._phases.phase("dispatch"):
-                    rngs = jax.random.split(
-                        self.net._keys.next(),
-                        xs.shape[0] * K).reshape(xs.shape[0], K)
-                    params_k, upd_k, ns_k, last_losses = self._step_fn(
-                        params_k, upd_k, ns_k, jnp.asarray(float(it)),
-                        jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
-                    )
+
+                    def dispatch(params_k=params_k, upd_k=upd_k, ns_k=ns_k):
+                        rngs = jax.random.split(
+                            self.net._keys.next(),
+                            xs.shape[0] * K).reshape(xs.shape[0], K)
+                        return self._step_fn(
+                            params_k, upd_k, ns_k, jnp.asarray(float(it)),
+                            jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms)
+
+                    if res is not None:
+                        params_k, upd_k, ns_k, last_losses = res.step(
+                            dispatch, it, net=net)
+                    else:
+                        params_k, upd_k, ns_k, last_losses = dispatch()
                 if self.collect_worker_stats:
                     self._publish_worker_stats(
                         last_losses, time.perf_counter() - t_disp0,
                         wait_s, xs)
-            it += n_batches // K
+            it += adv
             self._phases.steps += 1
+            if res is not None and res.cm is not None:
+                trigger = res.cm.due(it)
+                if trigger is not None:
+                    # fold the averaged replica-0 state into the facade
+                    # only when a save is actually due
+                    self._fold_back(net, params_k, upd_k, ns_k, it,
+                                    last_losses)
+                    res.cm.save(net, trigger=trigger)
 
-        # fold averaged replica-0 state back into the facade
+        self._fold_back(net, params_k, upd_k, ns_k, it, last_losses)
+        self.iteration = it - it0
+        return net
+
+    def _fold_back(self, net, params_k, upd_k, ns_k, it, last_losses):
+        """Fold the averaged replica-0 state back into the facade (loop
+        end, window-boundary checkpoint saves, preemption stop)."""
         net.params = jax.tree_util.tree_map(lambda a: a[0], params_k)
         net.updater_state = jax.tree_util.tree_map(lambda a: a[0], upd_k)
         net.net_state = jax.tree_util.tree_map(lambda a: a[0], ns_k)
         if last_losses is not None:
             net.score_value = last_losses[-1].mean()  # device scalar; lazy
-        self.iteration = it - net.iteration
         net.iteration = it
-        return net
 
     def phase_stats(self):
         """Per-phase wall-time aggregates of this wrapper's fit loop
@@ -362,9 +409,17 @@ class ParallelWrapper:
 
     def _publish_worker_stats(self, losses, dispatch_s: float,
                               wait_s: float, xs) -> None:
+        from deeplearning4j_tpu.resilience import get_fault_injector
+
         F = max(1, int(xs.shape[0]))
         B = int(xs.shape[2]) if xs.ndim >= 3 else None
-        for worker, t in self._worker_step_times(losses, dispatch_s).items():
+        times = self._worker_step_times(losses, dispatch_s)
+        inj = get_fault_injector()
+        if inj is not None:
+            # deterministic chaos: an injected per-worker delay makes the
+            # straggler detector's input reproducible in tests
+            times = {w: t + inj.worker_delay(w) for w, t in times.items()}
+        for worker, t in times.items():
             self._workers.observe(
                 worker, t / F, batch=B,
                 phases={"wait_window": wait_s / F, "dispatch": t / F})

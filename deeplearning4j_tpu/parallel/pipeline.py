@@ -194,7 +194,9 @@ class PipelineParallelTrainingMaster(TrainingMaster):
                  devices: Optional[Sequence] = None,
                  schedule: str = "gpipe",
                  mode: str = "auto",
-                 remat: bool = False):
+                 remat: bool = False,
+                 checkpoint_manager=None,
+                 retry_policy=None):
         if schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"schedule={schedule!r}: use 'gpipe' or '1f1b'")
         if mode not in ("auto", "compiled", "orchestrated"):
@@ -233,6 +235,11 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         # run all stages inside one XLA program, so there is no per-stage
         # host timing to publish there.
         self._workers: Optional[WorkerTelemetry] = None
+        # resilience wiring (docs/resilience.md): auto-resume on entry,
+        # step-boundary saves (stage params folded back into the facade
+        # only when a save is due), clean preemption stop, transient retry
+        self.checkpoint_manager = checkpoint_manager
+        self.retry_policy = retry_policy
 
     def training_stats(self) -> Dict[str, Any]:
         """Phase-timed stats: whole-step ``dispatch`` on the compiled paths,
@@ -682,7 +689,9 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         return instrument(jax.jit(step, donate_argnums=(0, 1)),
                           "PipelineParallelTrainingMaster.hetero_step", argnums=(2, 3, 4))
 
-    def _execute_hetero(self, net, iterator):
+    def _execute_hetero(self, net, iterator, res=None):
+        from deeplearning4j_tpu.resilience import preemption_requested
+
         M = self.n_microbatches
         if self._hetero_sharded:
             # flat f32 rows, one per stage, device s owns row s — params
@@ -698,7 +707,24 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             tree = jax.device_put(net.params, self._repl_sharding)
             opt_state = jax.device_put(net.updater_state,
                                        self._repl_sharding)
+
+        def unflatten_back():
+            if self._hetero_sharded:
+                net.params.update(self._hetero_unflatten_host(tree))
+                for k in net.updater_state:
+                    net.updater_state[k].update(self._hetero_unflatten_host(
+                        opt_state[k]["_pipe"]["w"]))
+            else:
+                net.params = tree
+                net.updater_state = opt_state
+
+        stopped = False
         for ds in iterator:
+            if res is not None and res.skip_batch():
+                continue   # auto-resume: batch already covered by the ckpt
+            if preemption_requested():
+                stopped = True
+                break
             if ds.features_mask is not None or ds.labels_mask is not None:
                 raise ValueError(
                     "pipeline master does not support masked batches")
@@ -716,21 +742,31 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             with step_guard("pipeline_step", component="pipeline_master",
                             iteration=net.iteration):
                 with self._phases.phase("dispatch"):
-                    tree, opt_state, loss = self._compiled_steps[key](
-                        tree, opt_state, jnp.asarray(float(net.iteration)),
-                        xs, ys)
+                    if res is not None:
+
+                        def dispatch(tree=tree, opt_state=opt_state):
+                            return self._compiled_steps[key](
+                                tree, opt_state,
+                                jnp.asarray(float(net.iteration)), xs, ys)
+
+                        tree, opt_state, loss = res.step(
+                            dispatch, net.iteration, net=net)
+                    else:
+                        tree, opt_state, loss = self._compiled_steps[key](
+                            tree, opt_state,
+                            jnp.asarray(float(net.iteration)), xs, ys)
             net.score_value = loss
             net.iteration += 1
             self._phases.steps += 1
             notify_listeners(net, len(x))
-        if self._hetero_sharded:
-            net.params.update(self._hetero_unflatten_host(tree))
-            for k in net.updater_state:
-                net.updater_state[k].update(self._hetero_unflatten_host(
-                    opt_state[k]["_pipe"]["w"]))
-        else:
-            net.params = tree
-            net.updater_state = opt_state
+            if res is not None and res.cm is not None:
+                trigger = res.cm.due(net.iteration)
+                if trigger is not None:
+                    unflatten_back()
+                    res.cm.save(net, trigger=trigger)
+        unflatten_back()
+        if stopped and res is not None:
+            res.on_preempt(net)
 
     # --- facade <-> pipeline param tree conversion (keys: pfx/ blk/ sfx/)
     def _stack_tree(self, per_layer: Dict[str, Any]) -> Dict[str, Any]:
@@ -878,7 +914,9 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         return instrument(jax.jit(step, donate_argnums=(0, 1)),
                           "PipelineParallelTrainingMaster.compiled_step", argnums=(2, 3, 4))
 
-    def _execute_compiled(self, net, iterator):
+    def _execute_compiled(self, net, iterator, res=None):
+        from deeplearning4j_tpu.resilience import preemption_requested
+
         M = self.n_microbatches
         tree = self._stack_tree(net.params)
         opt_state = {slot: self._stack_tree(per_layer)
@@ -889,7 +927,19 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             for k, v in t.items()}
         tree = place(tree)
         opt_state = {slot: place(t) for slot, t in opt_state.items()}
+
+        def unstack_back():
+            net.params.update(self._unstack_tree(tree))
+            for slot, t in opt_state.items():
+                net.updater_state[slot].update(self._unstack_tree(t))
+
+        stopped = False
         for ds in iterator:
+            if res is not None and res.skip_batch():
+                continue   # auto-resume: batch already covered by the ckpt
+            if preemption_requested():
+                stopped = True
+                break
             if ds.features_mask is not None or ds.labels_mask is not None:
                 raise ValueError("pipeline master does not support masked batches")
             x = np.asarray(ds.features)
@@ -906,26 +956,50 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             with step_guard("pipeline_step", component="pipeline_master",
                             iteration=net.iteration):
                 with self._phases.phase("dispatch"):
-                    tree, opt_state, loss = self._compiled_steps[key](
-                        tree, opt_state, jnp.asarray(float(net.iteration)),
-                        xs, ys)
+                    if res is not None:
+
+                        def dispatch(tree=tree, opt_state=opt_state):
+                            return self._compiled_steps[key](
+                                tree, opt_state,
+                                jnp.asarray(float(net.iteration)), xs, ys)
+
+                        tree, opt_state, loss = res.step(
+                            dispatch, net.iteration, net=net)
+                    else:
+                        tree, opt_state, loss = self._compiled_steps[key](
+                            tree, opt_state,
+                            jnp.asarray(float(net.iteration)), xs, ys)
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             self._phases.steps += 1
             notify_listeners(net, len(x))
-        net.params.update(self._unstack_tree(tree))
-        for slot, t in opt_state.items():
-            net.updater_state[slot].update(self._unstack_tree(t))
+            if res is not None and res.cm is not None:
+                trigger = res.cm.due(net.iteration)
+                if trigger is not None:
+                    # unstacking the whole tree is the fold-back cost; paid
+                    # only when a save is actually due
+                    unstack_back()
+                    res.cm.save(net, trigger=trigger)
+        unstack_back()
+        if stopped and res is not None:
+            res.on_preempt(net)
 
     # ---------------------------------------------------------------- train
     def execute_training(self, net, iterator):
+        from deeplearning4j_tpu.resilience import (
+            FitResilience, preemption_requested,
+        )
 
+        res = None
+        if self.checkpoint_manager is not None or self.retry_policy is not None:
+            res = FitResilience("pipeline_master", self.checkpoint_manager,
+                                self.retry_policy, net=net)
         if not self._built:
             self._build(net)
         if self._mode == "compiled":
             if self._compiled_kind == "hetero":
-                return self._execute_hetero(net, iterator)
-            return self._execute_compiled(net, iterator)
+                return self._execute_hetero(net, iterator, res)
+            return self._execute_compiled(net, iterator, res)
         S = len(self.stages)
         # place each stage's params + updater state on its device
         stage_params = [
@@ -943,14 +1017,37 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         if self._workers is None:
             self._workers = WorkerTelemetry("pipeline_master")
         for ds in iterator:
+            if res is not None and res.skip_batch():
+                continue   # auto-resume: batch already covered by the ckpt
+            if preemption_requested():
+                self._merge_back(net, stage_params, stage_upd)
+                if res is not None:
+                    res.on_preempt(net)
+                return
             with step_guard("pipeline_step", component="pipeline_master",
                             iteration=net.iteration):
-                loss = self._train_batch(net, ds, stage_params, stage_upd)
+                if res is not None:
+                    loss = res.step(
+                        lambda: self._train_batch(net, ds, stage_params,
+                                                  stage_upd),
+                        net.iteration, net=net)
+                else:
+                    loss = self._train_batch(net, ds, stage_params, stage_upd)
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             self._phases.steps += 1
             notify_listeners(net, len(ds))
-        # merge stage params back
+            if res is not None and res.cm is not None:
+                trigger = res.cm.due(net.iteration)
+                if trigger is not None:
+                    self._merge_back(net, stage_params, stage_upd)
+                    res.cm.save(net, trigger=trigger)
+        self._merge_back(net, stage_params, stage_upd)
+
+    def _merge_back(self, net, stage_params, stage_upd) -> None:
+        """Merge per-stage params/updater state back into the facade (loop
+        end, due checkpoint saves, preemption stop)."""
+        S = len(self.stages)
         for s in range(S):
             for name, p in stage_params[s].items():
                 net.params[name] = jax.device_put(p, self.devices[0])

@@ -88,11 +88,16 @@ class SyncTrainingMaster(TrainingMaster):
     """
 
     def __init__(self, mesh: Optional[Mesh] = None, batch_size: Optional[int] = None,
-                 prefetch_size: int = 2, collect_stats: bool = False):
+                 prefetch_size: int = 2, collect_stats: bool = False,
+                 checkpoint_manager=None, retry_policy=None):
         self.mesh = mesh or backend.default_mesh()
         self.batch_size = batch_size
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
+        # resilience wiring (docs/resilience.md): auto-resume on entry,
+        # boundary saves, clean preemption stop, transient step retry
+        self.checkpoint_manager = checkpoint_manager
+        self.retry_policy = retry_policy
         # step_time_ms is a bounded window (last 1024) — stats stay O(1)
         # however long training runs; PhaseStats carries the full aggregates
         self._stats: Dict[str, Any] = {
@@ -167,7 +172,16 @@ class SyncTrainingMaster(TrainingMaster):
     def execute_training(self, net, iterator):
         from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
         from deeplearning4j_tpu.models.common import notify_listeners
+        from deeplearning4j_tpu.resilience import (
+            FitResilience, preemption_requested,
+        )
 
+        res = None
+        if self.checkpoint_manager is not None or self.retry_policy is not None:
+            # resume BEFORE device placement so restored leaves get their
+            # saved PartitionSpecs over this master's mesh
+            res = FitResilience("sync_master", self.checkpoint_manager,
+                                self.retry_policy, net=net, mesh=self.mesh)
         if isinstance(iterator, DataSetIterator) and iterator.async_supported():
             iterator = AsyncDataSetIterator(iterator, self.prefetch_size)
         if self._step is None:
@@ -186,6 +200,15 @@ class SyncTrainingMaster(TrainingMaster):
                     ds = next(it)
                 except StopIteration:
                     break
+            if res is not None and res.skip_batch():
+                continue   # auto-resume: batch already covered by the ckpt
+            if preemption_requested():
+                # fold live state back so the priority checkpoint sees it
+                net.params, net.updater_state, net.net_state = (
+                    params, upd_state, ns)
+                if res is not None:
+                    res.on_preempt(net)
+                break
             n_real = len(ds)
             if len(ds) % K:
                 ds = ds.pad_batch(((len(ds) + K - 1) // K) * K)
@@ -200,13 +223,29 @@ class SyncTrainingMaster(TrainingMaster):
             with step_guard("sync_step", component="sync_master",
                             iteration=net.iteration):
                 with self._phases.phase("dispatch"):
-                    params, upd_state, ns, loss = self._step(
-                        params, upd_state, ns,
-                        jnp.asarray(float(net.iteration)),
-                        x, y, net._keys.next(), fm, lm,
-                    )
+                    if res is not None:
+                        params, upd_state, ns, loss = res.step(
+                            lambda: self._step(
+                                params, upd_state, ns,
+                                jnp.asarray(float(net.iteration)),
+                                x, y, net._keys.next(), fm, lm),
+                            net.iteration, net=net)
+                    else:
+                        params, upd_state, ns, loss = self._step(
+                            params, upd_state, ns,
+                            jnp.asarray(float(net.iteration)),
+                            x, y, net._keys.next(), fm, lm,
+                        )
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
+            if res is not None and res.cm is not None:
+                trigger = res.cm.due(net.iteration)
+                if trigger is not None:
+                    # fold live state into the facade only when a save is
+                    # actually due (the snapshot reads net.*)
+                    net.params, net.updater_state, net.net_state = (
+                        params, upd_state, ns)
+                    res.cm.save(net, trigger=trigger)
             if self.collect_stats:
                 if self._workers is None:
                     self._workers = WorkerTelemetry("sync_master")
@@ -215,9 +254,14 @@ class SyncTrainingMaster(TrainingMaster):
                 step_s = time.perf_counter() - t0
                 self._stats["step_time_ms"].append(step_s * 1e3)
                 per_dev = max(1, len(ds) // K)
+                from deeplearning4j_tpu.resilience import get_fault_injector
+
+                inj = get_fault_injector()
                 for worker, w_s in (worker_times
                                     or {str(i): step_s
                                         for i in range(K)}).items():
+                    if inj is not None:
+                        w_s += inj.worker_delay(worker)
                     self._workers.observe(worker, w_s, batch=per_dev)
             self._stats["steps"] += 1
             self._phases.steps += 1

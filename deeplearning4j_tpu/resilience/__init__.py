@@ -1,0 +1,43 @@
+"""Resilience subsystem: checkpointing, preemption, retry, fault injection.
+
+The reference stack survives worker loss via Spark lineage and re-dispatch
+(SparkNet §3, DeepSpark §3.2); the TPU-native port replaces that with a
+single-controller fault-tolerance layer (docs/resilience.md):
+
+- ``CheckpointManager`` — async snapshots with atomic commit (tmp ->
+  fsync -> rename + COMMIT manifest), keep-N + archival retention, and
+  torn-snapshot-proof ``latest()`` discovery;
+- ``PreemptionHandler`` — SIGTERM/SIGINT -> priority checkpoint -> clean
+  fit-loop stop at the next step boundary;
+- ``RetryPolicy`` — exponential-backoff-with-jitter step retry with
+  transient/fatal classification;
+- ``FaultInjector`` — the seeded deterministic chaos harness the tests
+  drive the real paths with (fail a step, crash the checkpoint writer
+  between files, corrupt a committed snapshot, slow a worker);
+- ``FitResilience`` — the per-fit-call driver the training loops embed
+  (auto-resume + skip, per-step retry scope, boundary save/stop duties).
+"""
+
+from deeplearning4j_tpu.resilience.checkpoint_manager import (
+    CheckpointError, CheckpointManager,
+)
+from deeplearning4j_tpu.resilience.faults import (
+    FaultInjector, InjectedFault, TransientInjectedFault,
+    get_fault_injector, inject_faults, set_fault_injector,
+)
+from deeplearning4j_tpu.resilience.integration import FitResilience
+from deeplearning4j_tpu.resilience.preemption import (
+    PreemptionHandler, get_preemption_handler, preemption_requested,
+)
+from deeplearning4j_tpu.resilience.retry import (
+    RetryPolicy, TransientError, is_transient,
+)
+
+__all__ = [
+    "CheckpointError", "CheckpointManager",
+    "FaultInjector", "InjectedFault", "TransientInjectedFault",
+    "get_fault_injector", "inject_faults", "set_fault_injector",
+    "FitResilience",
+    "PreemptionHandler", "get_preemption_handler", "preemption_requested",
+    "RetryPolicy", "TransientError", "is_transient",
+]

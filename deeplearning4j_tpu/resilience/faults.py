@@ -1,0 +1,213 @@
+"""Deterministic fault injection — the chaos harness the resilience tests
+drive the REAL code paths with.
+
+The reference stack's fault tolerance was exercised by Spark killing
+executors; here the equivalent is a seeded ``FaultInjector`` that the fit
+loops, the checkpoint writer, and the worker-telemetry seams consult at
+well-defined points:
+
+- ``fail_at_step(n)`` — the fit loops call ``on_step(component, step)``
+  inside their retry scope, so an injected step fault exercises the real
+  ``RetryPolicy`` backoff (transient) or the real crash-dump path (fatal);
+- ``crash_after_files(n)`` — ``CheckpointManager``'s writer calls
+  ``on_checkpoint_file(path)`` after each staged file, so the injector can
+  kill the writer BETWEEN shard files, leaving exactly the torn ``.tmp``
+  directory a preempted VM would;
+- ``delay_worker(k, seconds)`` — the in-process worker-timing seams add the
+  delay to worker ``k``'s reported step time, turning the straggler
+  detector's input deterministic;
+- ``corrupt_checkpoint(dir)`` — post-hoc bit-flip / truncation / marker
+  deletion of a COMMITTED checkpoint, for proving ``latest()`` skips torn
+  snapshots.
+
+Everything is seeded (``random.Random(seed)``) and counts deterministically
+— the same test run injects the same faults in the same order.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from deeplearning4j_tpu.resilience.retry import TransientError
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the FaultInjector (fatal flavor)."""
+
+
+class TransientInjectedFault(TransientError, InjectedFault):
+    """A fault the RetryPolicy classifies as transient (retryable)."""
+
+
+class FaultInjector:
+    """Seeded, deterministic fault harness (see module docstring).
+
+    All arming calls return ``self`` so rules chain::
+
+        inj = (FaultInjector(seed=7)
+               .fail_at_step(3, transient=True)
+               .crash_after_files(1))
+        with inject_faults(inj):
+            net.fit(iterator, checkpoint_manager=cm, retry_policy=rp)
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._step_rules: List[Dict[str, Any]] = []
+        self._file_crash_after: Optional[int] = None
+        self._file_crash_exc: Optional[BaseException] = None
+        self._files_seen = 0
+        self._worker_delays: Dict[str, float] = {}
+        self.injected: List[Dict[str, Any]] = []   # what fired, in order
+
+    # ------------------------------------------------------------ step faults
+    def fail_at_step(self, step: int, exc: Optional[BaseException] = None, *,
+                     component: Optional[str] = None, times: int = 1,
+                     transient: bool = True) -> "FaultInjector":
+        """Raise when a fit loop reaches global iteration ``step`` (fires
+        ``times`` times, then disarms; ``component`` narrows to one loop)."""
+        self._step_rules.append({
+            "step": int(step), "component": component,
+            "times": int(times), "exc": exc, "transient": transient,
+        })
+        return self
+
+    def on_step(self, component: str, step: int) -> None:
+        """Called by the fit loops at each step boundary (inside the retry
+        scope).  Raises if an armed rule matches."""
+        fire = None
+        with self._lock:
+            for rule in self._step_rules:
+                if rule["times"] <= 0:
+                    continue
+                if rule["step"] != int(step):
+                    continue
+                if rule["component"] and rule["component"] != component:
+                    continue
+                rule["times"] -= 1
+                fire = rule
+                break
+            if fire is not None:
+                self.injected.append({"kind": "step_fault",
+                                      "component": component, "step": step})
+        if fire is None:
+            return
+        if fire["exc"] is not None:
+            raise fire["exc"]
+        if fire["transient"]:
+            raise TransientInjectedFault(
+                f"injected transient fault at {component} step {step}")
+        raise InjectedFault(
+            f"injected fatal fault at {component} step {step}")
+
+    # ------------------------------------------------------- writer crashes
+    def crash_after_files(self, n: int,
+                          exc: Optional[BaseException] = None
+                          ) -> "FaultInjector":
+        """Kill the checkpoint writer after the ``n``-th staged file lands
+        (n=1 → crash between the shard file and the manifest)."""
+        self._file_crash_after = int(n)
+        self._file_crash_exc = exc
+        self._files_seen = 0
+        return self
+
+    def on_checkpoint_file(self, path: str) -> None:
+        """Called by ``write_snapshot`` after each staged checkpoint file."""
+        with self._lock:
+            if self._file_crash_after is None:
+                return
+            self._files_seen += 1
+            if self._files_seen != self._file_crash_after:
+                return
+            self._file_crash_after = None   # one-shot
+            self.injected.append({"kind": "writer_crash", "path": path})
+            exc = self._file_crash_exc
+        raise exc if exc is not None else InjectedFault(
+            f"injected writer crash after {path}")
+
+    # --------------------------------------------------------- slow workers
+    def delay_worker(self, worker, seconds: float) -> "FaultInjector":
+        """Make worker ``k`` look ``seconds`` slower to the telemetry seams
+        (deterministic straggler)."""
+        self._worker_delays[str(worker)] = float(seconds)
+        return self
+
+    def worker_delay(self, worker) -> float:
+        return self._worker_delays.get(str(worker), 0.0)
+
+    # --------------------------------------------------- on-disk corruption
+    def corrupt_checkpoint(self, directory: str, mode: str = "truncate"
+                           ) -> str:
+        """Damage a COMMITTED checkpoint directory in place; returns the
+        path touched.  Modes: ``truncate`` (cut a shard file in half),
+        ``corrupt`` (flip bytes at a seeded offset, size unchanged),
+        ``drop_commit`` (delete the COMMIT marker).  ``latest()`` must
+        refuse the result in every mode."""
+        if mode == "drop_commit":
+            path = os.path.join(directory, "COMMIT")
+            os.remove(path)
+            self.injected.append({"kind": "corrupt", "mode": mode,
+                                  "path": path})
+            return path
+        shards = sorted(f for f in os.listdir(directory)
+                        if f.startswith("shards-"))
+        if not shards:
+            raise FileNotFoundError(f"no shard files in {directory}")
+        path = os.path.join(directory, shards[0])
+        size = os.path.getsize(path)
+        if mode == "truncate":
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 2))
+        elif mode == "corrupt":
+            off = self.rng.randrange(max(1, size - 8))
+            with open(path, "r+b") as f:
+                f.seek(off)
+                chunk = f.read(8)
+                f.seek(off)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+        else:
+            raise ValueError(f"unknown corruption mode {mode!r}")
+        self.injected.append({"kind": "corrupt", "mode": mode, "path": path})
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._step_rules.clear()
+            self._file_crash_after = None
+            self._files_seen = 0
+            self._worker_delays.clear()
+            self.injected.clear()
+            self.rng = random.Random(self.seed)
+
+
+_active: Optional[FaultInjector] = None
+
+
+def get_fault_injector() -> Optional[FaultInjector]:
+    """The active injector, or None (the production value — every hook
+    site is a single global read + None check)."""
+    return _active
+
+
+def set_fault_injector(inj: Optional[FaultInjector]) -> Optional[FaultInjector]:
+    global _active
+    _active = inj
+    return inj
+
+
+@contextmanager
+def inject_faults(inj: FaultInjector):
+    """Scope an injector over a block (tests)."""
+    global _active
+    prev = _active
+    _active = inj
+    try:
+        yield inj
+    finally:
+        _active = prev

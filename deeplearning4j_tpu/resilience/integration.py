@@ -1,0 +1,111 @@
+"""Fit-loop resilience driver — the one object the training loops talk to.
+
+Every fit loop in the codebase (both facades, the sync master, the
+parallel wrapper, the pipeline master) wires resilience the same way, so
+the policy lives here once:
+
+1. **auto-resume** on entry: when a ``CheckpointManager`` with
+   ``auto_resume=True`` holds a checkpoint AHEAD of the model, restore it
+   (params / updater state / RNG stream / iteration) and skip the batches
+   the restored run already consumed — the restored run then replays the
+   exact step sequence of an uninterrupted one (resume-equivalence is the
+   subsystem's test oracle);
+2. **per-step scope**: the step dispatch runs inside the fault-injection
+   hook and the ``RetryPolicy`` (so an injected or real transient failure
+   retries the WHOLE step, injector included);
+3. **boundary duties**: after each step, ``maybe_save`` (step/wall-clock/
+   priority triggers); before each step, a preemption check — on SIGTERM
+   the loop commits a priority checkpoint and returns cleanly.
+
+The loops keep a ``None`` fast path: with no manager and no retry policy
+the only added cost is one module-global preemption read per step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from deeplearning4j_tpu.resilience.faults import get_fault_injector
+from deeplearning4j_tpu.resilience.preemption import preemption_requested
+
+
+class FitResilience:
+    """Per-fit-call resilience state (see module docstring)."""
+
+    def __init__(self, component: str, checkpoint_manager=None,
+                 retry_policy=None, *, net=None, mesh=None):
+        self.component = component
+        self.cm = checkpoint_manager
+        self.retry = retry_policy
+        self.resumed_from: Optional[int] = None
+        self.skip = 0              # batches the restored run already consumed
+        self._skipped = 0
+        self.stopped = False
+        if net is not None and self.cm is not None and self.cm.auto_resume:
+            entry = int(getattr(net, "iteration", 0))
+            restored = self.cm.resume(net, mesh=mesh)
+            if restored is not None:
+                self.resumed_from = restored
+                self.skip = restored - entry
+
+    # ------------------------------------------------------------ batch gate
+    def skip_batch(self) -> bool:
+        """True while replaying past batches a resumed checkpoint already
+        covers (call once per batch, before any compute)."""
+        if self._skipped < self.skip:
+            self._skipped += 1
+            return True
+        return False
+
+    def skip_window(self, steps: int) -> bool:
+        """Multi-iteration skip for a batch/window that advances the
+        iteration by ``steps`` (ParallelWrapper averaging windows,
+        ``num_iterations > 1``, TBPTT windows-per-batch).  Skips only when
+        the whole unit is covered — checkpoints are taken at batch/window
+        boundaries, so on the same batch stream the remaining skip is
+        always either 0 or >= ``steps``."""
+        remaining = self.skip - self._skipped
+        if remaining >= steps > 0:
+            self._skipped += steps
+            return True
+        return False
+
+    def should_stop(self) -> bool:
+        return self.stopped or preemption_requested()
+
+    # -------------------------------------------------------------- the step
+    def step(self, fn: Callable[[], Any], iteration: int, net=None) -> Any:
+        """Run one step dispatch under fault injection + retry.
+
+        With ``net`` given, the facade's RNG root key is snapshotted before
+        the first attempt and rewound before every retry — a retried step
+        replays the exact key an uninterrupted run would have used, so
+        retries never fork the RNG stream (resume-equivalence depends on
+        this)."""
+        keys = getattr(net, "_keys", None) if net is not None else None
+        saved_key = keys._key if keys is not None else None
+
+        def run():
+            if keys is not None:
+                keys._key = saved_key
+            inj = get_fault_injector()
+            if inj is not None:
+                inj.on_step(self.component, iteration)
+            return fn()
+
+        if self.retry is None:
+            return run()
+        return self.retry.run(run, description=f"{self.component} step",
+                              context={"iteration": iteration})
+
+    def after_step(self, net) -> None:
+        if self.cm is not None:
+            self.cm.maybe_save(net)
+
+    # --------------------------------------------------------------- stopping
+    def on_preempt(self, net) -> None:
+        """Commit a priority checkpoint (blocking — the process may be
+        about to die) and mark the fit stopped."""
+        self.stopped = True
+        if self.cm is not None:
+            self.cm.save_if_stale(net, trigger="preempt", block=True)

@@ -46,6 +46,10 @@ def pytest_configure(config):
         "markers",
         "tpu: must run on a real TPU chip "
         "(DL4J_TPU_TESTS=1 python -m pytest -m tpu)")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / chaos tests driving the resilience "
+        "subsystem (python -m pytest -m faults)")
 
 
 def pytest_collection_modifyitems(config, items):
