@@ -868,6 +868,128 @@ def bench_checkpoint(platform, peak):
     }
 
 
+def _elastic_measure(k=8, windows=48, delay_mult=10.0, batch=16):
+    """Measurement body for ``bench_elastic`` (importable so the bench can
+    re-run it in a subprocess with virtual devices when the local backend
+    has fewer than ``k``).  Two arms over identical data and faults — one
+    replica injected ``delay_mult`` x slow:
+
+    - lockstep (``degraded_mode=False``): every averaging window pays the
+      straggler's delay at the synchrony barrier — today's collapse;
+    - degraded (``degraded_mode=True``): the straggler is evicted after a
+      couple of windows and the barrier stops charging for it.
+    """
+    import jax
+
+    from deeplearning4j_tpu.backend import device as backend
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.parallel import ElasticConfig, ParallelWrapper
+    from deeplearning4j_tpu.resilience import FaultInjector, inject_faults
+
+    mesh = backend.default_mesh(data=k, devices=jax.devices()[:k])
+
+    def make_net():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater("sgd", learning_rate=0.05).list()
+                .layer(DenseLayer(n_in=32, n_out=64, activation="relu"))
+                .layer(OutputLayer(n_in=64, n_out=8, loss="mcxent",
+                                   activation="softmax")).build())
+        return MultiLayerNetwork(conf).init()
+
+    def make_batches(n):
+        rs = np.random.RandomState(11)
+        return [DataSet(rs.rand(batch, 32).astype(np.float32),
+                        np.eye(8, dtype=np.float32)[rs.randint(0, 8, batch)])
+                for _ in range(n)]
+
+    def run(config, injector, n_windows):
+        pw = ParallelWrapper(make_net(), workers=k, mesh=mesh,
+                             averaging_frequency=1, elastic=config)
+        data = make_batches(k * n_windows)
+        t0 = time.perf_counter()
+        if injector is None:
+            pw.fit(iter(data))
+        else:
+            with inject_faults(injector):
+                pw.fit(iter(data))
+        return time.perf_counter() - t0, pw
+
+    # calibration: healthy per-window cost (includes compile; discarded)
+    run(ElasticConfig(degraded_mode=False), None, 4)
+    healthy_s, _ = run(ElasticConfig(degraded_mode=False), None, 8)
+    healthy_window_s = healthy_s / 8
+    delay_s = max(delay_mult * healthy_window_s, 0.02)
+    victim = str(k // 2)
+
+    lock_s, _ = run(
+        ElasticConfig(degraded_mode=False, straggler_min_steps=2),
+        FaultInjector(seed=3).delay_worker(victim, delay_s), windows)
+    deg_s, pw = run(
+        ElasticConfig(evict_after_flags=2, straggler_min_steps=2,
+                      readmit_after_windows=10 ** 9),
+        FaultInjector(seed=3).delay_worker(victim, delay_s), windows)
+    summary = pw.elastic.summary()
+    return {
+        "replicas": k,
+        "windows": windows,
+        "batch": batch,
+        "healthy_window_ms": round(healthy_window_s * 1e3, 3),
+        "injected_delay_ms": round(delay_s * 1e3, 3),
+        "injected_worker": victim,
+        "lockstep_windows_per_sec": round(windows / lock_s, 2),
+        "degraded_windows_per_sec": round(windows / deg_s, 2),
+        "lockstep_samples_per_sec": round(windows * k * batch / lock_s, 1),
+        "degraded_samples_per_sec": round(windows * k * batch / deg_s, 1),
+        "degraded_vs_lockstep_speedup": round(lock_s / deg_s, 2),
+        "evicted": summary["evicted"],
+    }
+
+
+def bench_elastic(platform, peak):
+    """Elasticity payoff on record: ParallelWrapper throughput with 1-of-8
+    replicas injected 10x slow, degraded mode (evict + renormalize,
+    docs/resilience.md "Elasticity") vs today's lockstep behavior.  Needs
+    an 8-way data mesh, so on a smaller backend the measurement runs in a
+    subprocess with 8 virtual host devices (same code path the test tier
+    uses)."""
+    import subprocess
+
+    import jax
+
+    if len(jax.devices()) >= 8:
+        data = _elastic_measure()
+    else:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import json, bench; print(json.dumps(bench._elastic_measure()))"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=env, capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"elastic subprocess failed: {out.stderr[-300:]}")
+        data = json.loads(out.stdout.strip().splitlines()[-1])
+    return {
+        "metric": (f"Elastic DP samples/sec, 1-of-{data['replicas']} "
+                   f"replicas {round(data['injected_delay_ms'] / max(data['healthy_window_ms'], 1e-9))}x slow "
+                   f"(degraded mode: evict + renormalize)"),
+        "value": data["degraded_samples_per_sec"],
+        "unit": "samples/sec",
+        "vs_baseline": None,   # reference stalls on the straggler (lockstep)
+        "data": "synthetic",
+        "dtype": "float32",
+        **data,
+    }
+
+
 def main():
     baselines = _load_baselines()
     devices = _devices_with_retry()
@@ -891,7 +1013,8 @@ def main():
             ("decode", lambda: bench_decode(platform, peak)),
             ("long_context", lambda: bench_long_context(platform, peak)),
             ("serving", lambda: bench_serving(platform, peak)),
-            ("checkpoint", lambda: bench_checkpoint(platform, peak))):
+            ("checkpoint", lambda: bench_checkpoint(platform, peak)),
+            ("elastic", lambda: bench_elastic(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

@@ -361,6 +361,11 @@ class HealthRule:
       seconds: flags a run whose CheckpointManager stopped committing
       (or never started) long before the lost progress is discovered
       the hard way
+    - ``max_evicted_replicas`` — max gauge child
+      (``dl4j_elastic_evicted_replicas``) must be <= ``limit``: a
+      degraded-mode mesh (replicas evicted from the averaging collective,
+      docs/resilience.md "Elasticity") is tolerable up to a budget —
+      beyond it the run is limping and /health should say so
     - ``predicate`` — ``fn(extra) -> bool`` (or ``(ok, observed, detail)``)
       for liveness checks that live outside the registry
 
@@ -376,6 +381,7 @@ class HealthRule:
         "max_recompiles": "dl4j_recompiles_total",
         "max_stragglers": "dl4j_stragglers_total",
         "max_checkpoint_staleness": "dl4j_checkpoint_staleness_seconds",
+        "max_evicted_replicas": "dl4j_elastic_evicted_replicas",
     }
 
     def __init__(self, name: str, kind: str, limit: Optional[float] = None,
@@ -422,20 +428,23 @@ class HealthRule:
             v, labels = max(vals, key=lambda t: t[0])
             return v, f"worst child: {labels or 'unlabeled'}"
         if self.kind in ("max_queue_depth", "min_throughput",
-                         "max_checkpoint_staleness"):
+                         "max_checkpoint_staleness",
+                         "max_evicted_replicas"):
             vals = [(c.value, labels) for labels, c in children]
             vals = [(v, l) for v, l in vals if not math.isnan(v)]
             if not vals:
                 return None, "no gauge children yet"
-            # all three kinds take the MAX child: deepest queue for the
+            # all these kinds take the MAX child: deepest queue for the
             # depth cap, best current throughput for the floor (a stale
             # low gauge from a finished side model must not fail the
             # floor forever — narrow with labels= to watch one child),
-            # and the stalest checkpoint manager for the staleness cap
+            # the stalest checkpoint manager for the staleness cap, and
+            # the most-degraded component for the eviction budget
             v, labels = max(vals, key=lambda t: t[0])
             which = {"max_queue_depth": "deepest",
                      "min_throughput": "best",
-                     "max_checkpoint_staleness": "stalest"}[self.kind]
+                     "max_checkpoint_staleness": "stalest",
+                     "max_evicted_replicas": "most degraded"}[self.kind]
             return v, f"{which} child: {labels or 'unlabeled'}"
         # counters: sum over matching children
         if not children:
@@ -516,13 +525,16 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
                            max_recompiles: float = 100.0,
                            max_stragglers: Optional[float] = None,
                            max_checkpoint_staleness_s: Optional[float] = None,
+                           max_evicted_replicas: Optional[float] = None,
                            ) -> List[HealthRule]:
     """Sensible defaults for a training process: an optional step-time
     SLO, an optional throughput floor, a recompile budget (steady-state
     shape churn is the classic silent TPU throughput bug), an optional
     straggler budget, an optional checkpoint-staleness cap (a run whose
     CheckpointManager stopped committing fails /health while the progress
-    is still recoverable — docs/resilience.md)."""
+    is still recoverable — docs/resilience.md), and an optional evicted-
+    replica budget (degraded-mode training past the budget fails /health
+    even though the loop is still making progress)."""
     rules = [HealthRule("recompile_budget", "max_recompiles",
                         max_recompiles)]
     if max_step_p99_s is not None:
@@ -537,6 +549,9 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
         rules.append(HealthRule("checkpoint_staleness",
                                 "max_checkpoint_staleness",
                                 max_checkpoint_staleness_s))
+    if max_evicted_replicas is not None:
+        rules.append(HealthRule("evicted_replicas", "max_evicted_replicas",
+                                max_evicted_replicas))
     return rules
 
 

@@ -1,4 +1,8 @@
 from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.elastic import (
+    ElasticConfig,
+    ElasticController,
+)
 from deeplearning4j_tpu.parallel.training_master import (
     TrainingMaster,
     SyncTrainingMaster,

@@ -40,6 +40,7 @@ from deeplearning4j_tpu.observability import (
     PhaseTimers, WorkerTelemetry, get_registry, instrument, step_guard,
 )
 from deeplearning4j_tpu.optimize import updaters as upd
+from deeplearning4j_tpu.parallel.elastic import ElasticConfig, ElasticController
 
 
 def _stack_tree(tree, k: int):
@@ -76,13 +77,27 @@ class _WindowAssembler:
                 for ds in iterator:
                     window.append(ds)
                     if len(window) == K * F:
-                        if not put(stack_fn(window)):
+                        if not put(stack_fn(window, K * F)):
                             return
                         window = []
                 if window and not self._stop.is_set():
+                    # tail handling: emit the full frames as their own
+                    # window first — a whole-tail per-replica weight would
+                    # also discard those replicas' REAL earlier minibatches
+                    n_full = (len(window) // K) * K
+                    if n_full and not put(stack_fn(window[:n_full], n_full)):
+                        return
+                    window = window[n_full:]
+                if window and not self._stop.is_set():
+                    # partial final frame: duplicate the tail minibatch to
+                    # fill the K replica slots (keeps a compiled [1, K, ...]
+                    # shape); n_real lets the stacker weight the pad-filled
+                    # replicas out of the average (they'd double-count the
+                    # duplicate)
+                    n_real = len(window)
                     while len(window) % K:
-                        window.append(window[-1])  # duplicate to fill replicas
-                    put(stack_fn(window))
+                        window.append(window[-1])
+                    put(stack_fn(window, n_real))
             except BaseException as e:
                 self._error = e
             finally:
@@ -133,6 +148,7 @@ class ParallelWrapper:
         collect_worker_stats: bool = False,
         checkpoint_manager=None,
         retry_policy=None,
+        elastic=False,
     ):
         self.net = net
         # resilience wiring (docs/resilience.md): auto-resume on fit entry,
@@ -162,6 +178,35 @@ class ParallelWrapper:
         # (same gating as SyncTrainingMaster's collect_stats).
         self.collect_worker_stats = collect_worker_stats
         self._workers: Optional[WorkerTelemetry] = None
+        # elasticity (docs/resilience.md "Elasticity"): evict a straggling,
+        # hung, or dead replica from the averaging collective via a runtime
+        # [K] weight mask (no recompile), renormalize over the healthy set,
+        # re-admit at a window boundary after the fault clears.  Pass True
+        # or an ElasticConfig; requires worker stats for straggler verdicts.
+        # An existing ElasticController is adopted as-is so eviction state
+        # can outlive one wrapper (ParameterAveragingTrainingMaster builds
+        # a fresh wrapper per epoch around one persistent controller).
+        self._elastic: Optional[ElasticController] = None
+        self._ones_w: Optional[np.ndarray] = None
+        if isinstance(elastic, ElasticController):
+            if elastic.K != self.workers:
+                raise ValueError(
+                    f"elastic controller tracks {elastic.K} workers, "
+                    f"wrapper has {self.workers}")
+            self.collect_worker_stats = True
+            self._elastic = elastic
+        elif elastic is not False and elastic is not None:
+            cfg = elastic if isinstance(elastic, ElasticConfig) else ElasticConfig()
+            self.collect_worker_stats = True
+            self._elastic = ElasticController(
+                "parallel_wrapper", [str(k) for k in range(self.workers)],
+                config=cfg)
+
+    @property
+    def elastic(self) -> Optional[ElasticController]:
+        """The elasticity state machine (None unless ``elastic=`` was
+        passed) — ``elastic.summary()`` is the operator view."""
+        return self._elastic
 
     # -- sharding specs ----------------------------------------------------
     def _replica_sharding(self):
@@ -191,9 +236,15 @@ class ParallelWrapper:
 
         vstep = jax.vmap(one_replica_step, in_axes=(0, 0, 0, None, 0, 0, 0, 0, 0))
 
-        def fit_window(params_k, upd_k, ns_k, iteration, xs, ys, rngs, fms, lms):
+        def fit_window(params_k, upd_k, ns_k, iteration, xs, ys, rngs, fms, lms,
+                       weights):
             """avg_freq minibatches per replica, then average.
-            xs: [avg_freq, K, B, ...]"""
+            xs: [avg_freq, K, B, ...]; weights: [K] replica weights — 0 for
+            evicted replicas (degraded mode) and pad-filled tail replicas,
+            1 otherwise.  The average is renormalized over the weighted
+            set and broadcast into ALL K slots, so an evicted replica's
+            slot always holds the current healthy average (that broadcast
+            IS the re-admission catch-up)."""
 
             def body(carry, inp):
                 p, u, n, it = carry
@@ -204,23 +255,27 @@ class ParallelWrapper:
             (params_k, upd_k, ns_k, _), losses = jax.lax.scan(
                 body, (params_k, upd_k, ns_k, iteration), (xs, ys, rngs, fms, lms)
             )
-            # parameter averaging: all-reduce over the replica axis then
-            # re-broadcast (reference averageAndPropagate semantics)
-            params_k = jax.tree_util.tree_map(
-                lambda a: jnp.broadcast_to(jnp.mean(a, 0, keepdims=True), a.shape), params_k
-            )
-            ns_k = jax.tree_util.tree_map(
-                lambda a: jnp.broadcast_to(jnp.mean(a, 0, keepdims=True), a.shape), ns_k
-            )
+            # parameter averaging: weighted all-reduce over the replica
+            # axis then re-broadcast (reference averageAndPropagate
+            # semantics, renormalized over the healthy/unpadded set —
+            # sum(w)=K with all weights 1 reproduces the plain mean
+            # bit-for-bit ... the caller guarantees sum(w) > 0)
+            wsum = jnp.sum(weights)
+
+            def wavg(a):
+                w = weights.reshape((a.shape[0],) + (1,) * (a.ndim - 1))
+                m = jnp.sum(a * w, 0, keepdims=True) / wsum
+                return jnp.broadcast_to(m.astype(a.dtype), a.shape)
+
+            params_k = jax.tree_util.tree_map(wavg, params_k)
+            ns_k = jax.tree_util.tree_map(wavg, ns_k)
             if average_updaters:
-                upd_k = jax.tree_util.tree_map(
-                    lambda a: jnp.broadcast_to(jnp.mean(a, 0, keepdims=True), a.shape), upd_k
-                )
+                upd_k = jax.tree_util.tree_map(wavg, upd_k)
             return params_k, upd_k, ns_k, losses
 
         self._step_fn = instrument(
             jax.jit(fit_window, donate_argnums=(0, 1, 2)),
-            "ParallelWrapper.fit_window", argnums=(3, 4, 5, 6, 7, 8))
+            "ParallelWrapper.fit_window", argnums=(3, 4, 5, 6, 7, 8, 9))
 
     # -- fit ---------------------------------------------------------------
     def fit(self, iterator):
@@ -274,7 +329,13 @@ class ParallelWrapper:
             "Data-parallel replica count of the active ParallelWrapper",
         ).set(K)
         if self.collect_worker_stats and self._workers is None:
-            self._workers = WorkerTelemetry("parallel_wrapper")
+            if self._elastic is not None:
+                self._workers = self._elastic.cfg.make_worker_telemetry(
+                    "parallel_wrapper")
+            else:
+                self._workers = WorkerTelemetry("parallel_wrapper")
+        if self._elastic is not None and self._workers is not None:
+            self._elastic.attach_detector(self._workers.detector)
         it0 = it = net.iteration
         last_losses = None
         win_iter = iter(windows)
@@ -285,7 +346,7 @@ class ParallelWrapper:
             wait_s = time.perf_counter() - t_wait0
             if win is None:
                 break
-            xs, ys, fms, lms, n_batches = win
+            xs, ys, fms, lms, n_batches, pad_w = win
             adv = n_batches // K
             if res is not None and res.skip_window(adv):
                 # auto-resume: consume the window the restored iteration
@@ -299,18 +360,21 @@ class ParallelWrapper:
                     windows.close()
                 self.iteration = it - it0
                 return net
+            weights = self._window_weights(it, pad_w)
             t_disp0 = time.perf_counter()
             with step_guard("parallel_window",
                             component="parallel_wrapper", iteration=it):
                 with self._phases.phase("dispatch"):
 
-                    def dispatch(params_k=params_k, upd_k=upd_k, ns_k=ns_k):
+                    def dispatch(params_k=params_k, upd_k=upd_k, ns_k=ns_k,
+                                 weights=weights):
                         rngs = jax.random.split(
                             self.net._keys.next(),
                             xs.shape[0] * K).reshape(xs.shape[0], K)
                         return self._step_fn(
                             params_k, upd_k, ns_k, jnp.asarray(float(it)),
-                            jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms)
+                            jnp.asarray(xs), jnp.asarray(ys), rngs, fms, lms,
+                            jnp.asarray(weights))
 
                     if res is not None:
                         params_k, upd_k, ns_k, last_losses = res.step(
@@ -321,6 +385,12 @@ class ParallelWrapper:
                     self._publish_worker_stats(
                         last_losses, time.perf_counter() - t_disp0,
                         wait_s, xs)
+            if self._elastic is not None:
+                # synchrony-barrier simulation (outside the telemetry
+                # window so per-worker attribution stays per-worker):
+                # lockstep pays the slowest ACTIVE worker's injected
+                # delay; degraded mode's win is the stall it stops paying
+                self._elastic.window_barrier(it)
             it += adv
             self._phases.steps += 1
             if res is not None and res.cm is not None:
@@ -335,6 +405,30 @@ class ParallelWrapper:
         self._fold_back(net, params_k, upd_k, ns_k, it, last_losses)
         self.iteration = it - it0
         return net
+
+    def _window_weights(self, it: int, pad_w):
+        """Combine the elastic eviction mask with the tail-padding weights
+        into the [K] weight vector the jitted window consumes.  The
+        all-ones fast path covers every healthy full window.  When every
+        replica holding real data is also evicted (pathological overlap of
+        a ragged tail with a degraded mesh), the eviction mask alone wins
+        — training on a duplicate minibatch beats dividing by zero or
+        averaging in a dead replica."""
+        mask = None
+        if self._elastic is not None:
+            mask = self._elastic.begin_window(it)
+            if mask.min() >= 1.0:
+                mask = None
+        if mask is None and pad_w is None:
+            if self._ones_w is None or len(self._ones_w) != self.workers:
+                self._ones_w = np.ones(self.workers, np.float32)
+            return self._ones_w
+        if mask is None:
+            return pad_w
+        if pad_w is None:
+            return mask
+        combined = mask * pad_w
+        return combined if combined.sum() > 0 else mask
 
     def _fold_back(self, net, params_k, upd_k, ns_k, it, last_losses):
         """Fold the averaged replica-0 state back into the facade (loop
@@ -434,9 +528,15 @@ class ParallelWrapper:
     def straggler_detector(self):
         return self._workers.detector if self._workers else None
 
-    def _stack_window(self, window):
+    def _stack_window(self, window, n_real=None):
         """Host half of a window step: pad + stack to [F, K, B, ...].
-        Runs on the assembler thread, not the dispatch thread."""
+        Runs on the assembler thread, not the dispatch thread.
+
+        ``n_real`` is the count of REAL minibatches in ``window`` — the
+        assembler duplicates the tail minibatch to fill the last row of K
+        replica slots, and those pad-filled slots must be weighted out of
+        the window's parameter average or the duplicate is double-counted
+        (the tail-window bias fix; ``_pad_weights``)."""
         K = self.workers
         F = len(window) // K
         # equalize batch sizes across the window (short/ragged final batches)
@@ -446,7 +546,24 @@ class ParallelWrapper:
         ys = np.stack([np.stack([w.labels for w in window[f * K : (f + 1) * K]]) for f in range(F)])
         fms = self._stack_masks([w.features_mask for w in window], K, F)
         lms = self._stack_masks([w.labels_mask for w in window], K, F)
-        return xs, ys, fms, lms, len(window)
+        n_real = len(window) if n_real is None else n_real
+        return xs, ys, fms, lms, len(window), \
+            self._pad_weights(n_real, len(window))
+
+    def _pad_weights(self, n_real: int, n_slots: int):
+        """[K] replica weights for a window whose minibatch slots past
+        ``n_real`` are padding (duplicated tail batch in the generic path,
+        zero-filled batches in the native path), or None when full.  Slot
+        ``i`` belongs to replica ``i % K`` (rows are contiguous K-blocks),
+        and the padding always lands in the last row, so a zero weight
+        names exactly the replicas whose final scan step saw no real
+        data."""
+        if n_real >= n_slots:
+            return None
+        w = np.ones(self.workers, np.float32)
+        for i in range(n_real, n_slots):
+            w[i % self.workers] = 0.0
+        return w
 
     def _native_windows(self, iterator):
         """Whole windows as single native gathers: the C++ producer thread
@@ -480,20 +597,44 @@ class ParallelWrapper:
                 if n_valid == slab:
                     xs = feat.reshape((F, K, B) + feat.shape[1:])
                     ys = lab.reshape((F, K, B) + lab.shape[1:])
-                    yield xs, ys, None, None, F * K
+                    yield xs, ys, None, None, F * K, None
                     continue
-                # tail: keep only the batches the data actually fills,
-                # rounded up to a multiple of K replicas
+                # tail: keep only the batches the data actually fills, and
+                # emit the FULL frames as their own window first (a
+                # whole-tail per-replica weight would also discard those
+                # replicas' real earlier minibatches from the average)
                 nb = -(-n_valid // B)          # ceil: batches with any data
-                L = -(-nb // K) * K            # pad batch count to K
-                rows = L * B
-                xs = feat[:rows].reshape((L // K, K, B) + feat.shape[1:])
-                ys = lab[:rows].reshape((L // K, K, B) + lab.shape[1:])
-                shape = ((rows,) if ys.ndim == 4 else (rows, ys.shape[3]))
-                m = np.zeros(shape, np.float32)
+                f_full = nb // K               # complete K-replica frames
+                mshape = ((nb * B,) if lab.ndim == 2
+                          else (nb * B, lab.shape[1]))
+                m = np.zeros(mshape, np.float32)
                 m[:n_valid] = 1.0
-                lms = jnp.asarray(m.reshape((L // K, K, B) + m.shape[1:]))
-                yield xs, ys, None, lms, L
+
+                def part(lo_b, n_b, n_real_b):
+                    """Window over batch slots [lo_b, lo_b + n_b)."""
+                    rows = slice(lo_b * B, (lo_b + n_b) * B)
+                    xs = feat[rows].reshape(
+                        (n_b // K, K, B) + feat.shape[1:])
+                    ys = lab[rows].reshape((n_b // K, K, B) + lab.shape[1:])
+                    mp = np.zeros((n_b * B,) + m.shape[1:], np.float32)
+                    avail = min(len(m) - lo_b * B, n_b * B)
+                    if avail > 0:
+                        mp[:avail] = m[lo_b * B:lo_b * B + avail]
+                    lms = (None if mp.all() else jnp.asarray(
+                        mp.reshape((n_b // K, K, B) + mp.shape[1:])))
+                    # replicas whose batch slot is entirely zero padding
+                    # are weighted out of the average: the labels mask
+                    # already zeroes their LOSS, but a zero-grad step
+                    # still mutates stateful updaters (Adam moments
+                    # decay), so averaging their params back in would
+                    # bias toward the pad
+                    return (xs, ys, None, lms, n_b,
+                            self._pad_weights(n_real_b - lo_b, n_b))
+
+                if f_full:
+                    yield part(0, f_full * K, nb)
+                if nb % K:
+                    yield part(f_full * K, K, nb)
         finally:
             batcher.close()
 

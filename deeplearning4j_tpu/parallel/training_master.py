@@ -42,6 +42,7 @@ from deeplearning4j_tpu.observability import (
     PhaseTimers, WorkerTelemetry, crash_dump, instrument, step_guard,
 )
 from deeplearning4j_tpu.optimize import updaters as upd
+from deeplearning4j_tpu.parallel.elastic import ElasticConfig, ElasticController
 
 
 def initialize_distributed(coordinator_address: Optional[str] = None,
@@ -89,11 +90,27 @@ class SyncTrainingMaster(TrainingMaster):
 
     def __init__(self, mesh: Optional[Mesh] = None, batch_size: Optional[int] = None,
                  prefetch_size: int = 2, collect_stats: bool = False,
-                 checkpoint_manager=None, retry_policy=None):
+                 checkpoint_manager=None, retry_policy=None, elastic=False):
         self.mesh = mesh or backend.default_mesh()
         self.batch_size = batch_size
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
+        # elasticity (docs/resilience.md "Elasticity"): a dead/hung/
+        # straggling data shard is evicted by zeroing its rows in the
+        # labels mask — the masked loss mean renormalizes over the healthy
+        # rows (losses.score divides by sum(mask)), so the gradient is the
+        # DeepSpark-style average over the degraded worker set.  Params
+        # stay replicated, so re-admission needs no catch-up: the mask
+        # just flips back.  Pass True or an ElasticConfig.
+        self._elastic: Optional[ElasticController] = None
+        if elastic is not False and elastic is not None:
+            ecfg = elastic if isinstance(elastic, ElasticConfig) else ElasticConfig()
+            self.collect_stats = True        # straggler verdicts need stats
+            slots = self._data_slot_devices()
+            self._elastic = ElasticController(
+                "sync_master", [f"d{s[0].id}" for s in slots], config=ecfg,
+                aliases={f"d{s[0].id}": [f"d{d.id}" for d in s]
+                         for s in slots})
         # resilience wiring (docs/resilience.md): auto-resume on entry,
         # boundary saves, clean preemption stop, transient step retry
         self.checkpoint_manager = checkpoint_manager
@@ -114,6 +131,46 @@ class SyncTrainingMaster(TrainingMaster):
         # already pays in its device_sync phase)
         self._workers: Optional[WorkerTelemetry] = None
         self._step = None
+
+    @property
+    def elastic(self) -> Optional[ElasticController]:
+        """The elasticity state machine (None unless ``elastic=`` was
+        passed) — ``elastic.summary()`` is the operator view."""
+        return self._elastic
+
+    def _data_slot_devices(self):
+        """Devices grouped by data-axis slot: ``order[k]`` is EVERY device
+        holding slot ``k`` of the [K]-sharded batch (one on a pure-DP
+        mesh, model*seq of them on a composed mesh).  The first member
+        names the slot (``d<id>``) for the elastic controller; the rest
+        become its aliases, so telemetry verdicts and injected faults on
+        ANY member evict the whole slot."""
+        K = self.mesh.shape[backend.AXIS_DATA]
+        sh = NamedSharding(self.mesh, P(backend.AXIS_DATA))
+        order = [[] for _ in range(K)]
+        # the GLOBAL device map: on a multi-host mesh the addressable map
+        # only covers this host's devices, which would leave remote hosts'
+        # slots empty (and slot naming must agree across processes anyway)
+        for dev, idx in sh.devices_indices_map((K,)).items():
+            sl = idx[0] if idx else slice(None)
+            for i in range(*sl.indices(K)):
+                order[i].append(dev)
+        for slot in order:
+            slot.sort(key=lambda d: d.id)
+        return order
+
+    def _evicted_labels_mask(self, ds, emask, K: int):
+        """Labels mask with the evicted data slots' rows zeroed (existing
+        mask respected).  The masked score normalizes by ``sum(mask)``, so
+        zeroed rows renormalize the global gradient mean over the healthy
+        rows — eviction without touching the compiled collective."""
+        B = len(ds)
+        rw = np.repeat(np.asarray(emask, np.float32), B // K)
+        lm = ds.labels_mask
+        if lm is None:
+            return rw.reshape((B,) + (1,) * (ds.labels.ndim - 2))
+        lm = np.asarray(lm)
+        return lm * rw.reshape((B,) + (1,) * (lm.ndim - 1))
 
     def _param_layout(self, net):
         """Sharding (single or per-param pytree) for the parameters.  Base:
@@ -212,14 +269,34 @@ class SyncTrainingMaster(TrainingMaster):
             n_real = len(ds)
             if len(ds) % K:
                 ds = ds.pad_batch(((len(ds) + K - 1) // K) * K)
+            emask = None
+            step0 = net.iteration   # pre-advance: barrier polls the SAME
+            if self._elastic is not None:   # step begin_window decided on
+                emask = self._elastic.begin_window(step0)
+                if emask.min() >= 1.0:
+                    emask = None    # healthy mesh: untouched fast path
             t0 = time.perf_counter()
             with self._phases.phase("place"):
                 x = jax.device_put(jnp.asarray(ds.features), self._data_sharding)
                 y = jax.device_put(jnp.asarray(ds.labels), self._data_sharding)
                 fm = None if ds.features_mask is None else jax.device_put(
                     jnp.asarray(ds.features_mask), self._data_sharding)
-                lm = None if ds.labels_mask is None else jax.device_put(
-                    jnp.asarray(ds.labels_mask), self._data_sharding)
+                if self._elastic is None:
+                    lm_host = ds.labels_mask
+                elif emask is not None:
+                    lm_host = self._evicted_labels_mask(ds, emask, K)
+                elif ds.labels_mask is not None:
+                    lm_host = ds.labels_mask
+                else:
+                    # elasticity keeps ONE trace: the mask argument is
+                    # always an array (all-ones == the unmasked mean), so
+                    # the first eviction flips values, not the pytree —
+                    # no recompile at the moment the mesh degrades
+                    lm_host = np.ones(
+                        (len(ds),) + (1,) * (ds.labels.ndim - 2),
+                        np.float32)
+                lm = None if lm_host is None else jax.device_put(
+                    jnp.asarray(lm_host), self._data_sharding)
             with step_guard("sync_step", component="sync_master",
                             iteration=net.iteration):
                 with self._phases.phase("dispatch"):
@@ -248,7 +325,14 @@ class SyncTrainingMaster(TrainingMaster):
                     res.cm.save(net, trigger=trigger)
             if self.collect_stats:
                 if self._workers is None:
-                    self._workers = WorkerTelemetry("sync_master")
+                    if self._elastic is not None:
+                        self._workers = (
+                            self._elastic.cfg.make_worker_telemetry(
+                                "sync_master"))
+                    else:
+                        self._workers = WorkerTelemetry("sync_master")
+                    if self._elastic is not None:
+                        self._elastic.attach_detector(self._workers.detector)
                 with self._phases.phase("device_sync"):
                     worker_times = self._measure_worker_sync(loss, t0)
                 step_s = time.perf_counter() - t0
@@ -263,6 +347,10 @@ class SyncTrainingMaster(TrainingMaster):
                     if inj is not None:
                         w_s += inj.worker_delay(worker)
                     self._workers.observe(worker, w_s, batch=per_dev)
+            if self._elastic is not None:
+                # synchrony-barrier simulation (fault injection only):
+                # lockstep pays the slowest ACTIVE worker's delay per step
+                self._elastic.window_barrier(step0)
             self._stats["steps"] += 1
             self._phases.steps += 1
             notify_listeners(net, n_real)
@@ -303,6 +391,8 @@ class SyncTrainingMaster(TrainingMaster):
         out.update(self._phases.as_dict())
         if self._workers is not None:
             out["cluster"] = self._workers.cluster_view()
+        if self._elastic is not None:
+            out["elastic"] = self._elastic.summary()
         return out
 
 
@@ -319,7 +409,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def __init__(self, workers: Optional[int] = None, batch_size: int = 32,
                  averaging_frequency: int = 5, average_updaters: bool = True,
                  prefetch_size: int = 2, repartition: str = "always",
-                 mesh: Optional[Mesh] = None, collect_stats: bool = False):
+                 mesh: Optional[Mesh] = None, collect_stats: bool = False,
+                 elastic=False):
         self.mesh = mesh or backend.default_mesh()
         self.workers = workers or self.mesh.shape[backend.AXIS_DATA]
         self.batch_size = batch_size
@@ -327,8 +418,24 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.average_updaters = average_updaters
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
+        # One persistent controller shared by every per-fit ParallelWrapper:
+        # eviction state and flag budgets survive epoch boundaries instead
+        # of resetting with each epoch's fresh wrapper.
+        self._elastic: Optional[ElasticController] = None
+        if elastic is not False and elastic is not None:
+            ecfg = (elastic if isinstance(elastic, ElasticConfig)
+                    else ElasticConfig())
+            self._elastic = ElasticController(
+                "parallel_wrapper", [str(k) for k in range(self.workers)],
+                config=ecfg)
         self._stats: Dict[str, Any] = {"windows": 0}
         self._phases = PhaseStats(component="param_avg_master")
+
+    @property
+    def elastic(self) -> Optional[ElasticController]:
+        """The elasticity state machine (None unless ``elastic=`` was
+        passed) — ``elastic.summary()`` is the operator view."""
+        return self._elastic
 
     def execute_training(self, net, iterator):
         from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
@@ -340,6 +447,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             averaging_frequency=self.averaging_frequency,
             average_updaters=self.average_updaters,
             mesh=self.mesh,
+            elastic=self._elastic if self._elastic is not None else False,
         )
         with self._phases.phase("fit"):
             pw.fit(iterator)
@@ -349,6 +457,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def training_stats(self):
         out = dict(self._stats)
         out.update(self._phases.as_dict())
+        if self._elastic is not None:
+            out["elastic"] = self._elastic.summary()
         return out
 
 

@@ -542,9 +542,16 @@ class CheckpointManager:
             self._last_commit_mono = time.monotonic()
             self._last_mark_step = out[3]
             self._last_mark_time = time.monotonic()
+        attrs = {}
+        if mesh is not None:
+            # topology portability (parallel.checkpoint resharded restore):
+            # record WHERE the snapshot landed — a resumed-on-a-new-mesh or
+            # promoted-into-serving restore is visible in the post-mortem
+            attrs["mesh"] = "x".join(
+                str(mesh.shape[a]) for a in mesh.axis_names)
         get_flight_recorder().record(
             "checkpoint_restore", directory=self.directory,
-            path=path, iteration=out[3])
+            path=path, iteration=out[3], **attrs)
         return out
 
     def resume(self, net, *, mesh=None) -> Optional[int]:
@@ -553,6 +560,12 @@ class CheckpointManager:
         place and return the restored iteration; otherwise leave ``net``
         untouched and return None.  The fit loops call this on entry when
         given a manager with ``auto_resume=True``.
+
+        ``mesh`` need NOT match the topology that saved: the resharded
+        restore (``parallel.checkpoint``) maps any saved layout onto any
+        target mesh — a 2x4 checkpoint resumes on 1x8, a K=4 run resumes
+        on K=2, a training snapshot promotes into a differently-sharded
+        serving mesh — with no global host gather of a sharded leaf.
 
         Cost discipline: the cheap COMMIT manifest decides "is it ahead?"
         BEFORE the full size+CRC verification — a fit entry that has

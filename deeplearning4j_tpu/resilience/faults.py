@@ -15,7 +15,15 @@ well-defined points:
   directory a preempted VM would;
 - ``delay_worker(k, seconds)`` — the in-process worker-timing seams add the
   delay to worker ``k``'s reported step time, turning the straggler
-  detector's input deterministic;
+  detector's input deterministic (and, when an ``ElasticController`` is
+  attached, the synchrony-barrier simulation actually stalls the window
+  by the slowest ACTIVE worker's delay — the lockstep collapse the
+  elasticity layer exists to fix);
+- ``hang_worker(k)`` / ``kill_worker(k, at_step)`` — mark a worker hung
+  (stops responding) or dead (process gone) from a given step; the
+  elastic layer polls ``worker_state(k, step)`` at every window boundary
+  and evicts, and ``until_step`` / ``clear_worker`` model the fault
+  clearing so re-admission paths are just as deterministic;
 - ``corrupt_checkpoint(dir)`` — post-hoc bit-flip / truncation / marker
   deletion of a COMMITTED checkpoint, for proving ``latest()`` skips torn
   snapshots.
@@ -64,6 +72,7 @@ class FaultInjector:
         self._file_crash_exc: Optional[BaseException] = None
         self._files_seen = 0
         self._worker_delays: Dict[str, float] = {}
+        self._worker_states: List[Dict[str, Any]] = []
         self.injected: List[Dict[str, Any]] = []   # what fired, in order
 
     # ------------------------------------------------------------ step faults
@@ -141,6 +150,73 @@ class FaultInjector:
     def worker_delay(self, worker) -> float:
         return self._worker_delays.get(str(worker), 0.0)
 
+    def clear_worker_delay(self, worker) -> "FaultInjector":
+        """Remove an armed ``delay_worker`` (the straggler recovered)."""
+        self._worker_delays.pop(str(worker), None)
+        return self
+
+    # ------------------------------------------------------ hung/dead workers
+    def hang_worker(self, worker, at_step: int = 0, *,
+                    until_step: Optional[int] = None) -> "FaultInjector":
+        """Worker ``k`` stops responding from global step ``at_step``
+        (state ``"hung"``): it never reports a step result, so a lockstep
+        run stalls on it forever while an elastic run evicts it at the
+        next window boundary.  ``until_step`` models the hang clearing on
+        its own (deterministic re-admission tests); ``clear_worker`` does
+        it explicitly."""
+        self._worker_states.append({
+            "worker": str(worker), "kind": "hung", "at_step": int(at_step),
+            "until_step": None if until_step is None else int(until_step),
+            "fired": False,
+        })
+        return self
+
+    def kill_worker(self, worker, at_step: int, *,
+                    until_step: Optional[int] = None) -> "FaultInjector":
+        """Worker ``k`` dies at global step ``at_step`` (state ``"dead"``
+        — the per-worker SIGTERM / preempted-VM case).  ``until_step``
+        models a replacement worker coming back for re-admission."""
+        self._worker_states.append({
+            "worker": str(worker), "kind": "dead", "at_step": int(at_step),
+            "until_step": None if until_step is None else int(until_step),
+            "fired": False,
+        })
+        return self
+
+    def clear_worker(self, worker) -> "FaultInjector":
+        """Clear every armed hang/kill for ``worker`` (the fault is over;
+        an elastic run re-admits at the next window boundary)."""
+        worker = str(worker)
+        with self._lock:
+            self._worker_states = [r for r in self._worker_states
+                                   if r["worker"] != worker]
+        return self
+
+    def worker_state(self, worker, step: int) -> str:
+        """``"ok"`` | ``"hung"`` | ``"dead"`` for ``worker`` at global
+        ``step`` — the elastic layer polls this at window boundaries.
+        ``"dead"`` wins over ``"hung"`` when both are armed."""
+        worker = str(worker)
+        state = "ok"
+        with self._lock:
+            for rule in self._worker_states:
+                if rule["worker"] != worker:
+                    continue
+                if int(step) < rule["at_step"]:
+                    continue
+                if (rule["until_step"] is not None
+                        and int(step) >= rule["until_step"]):
+                    continue
+                if not rule["fired"]:
+                    rule["fired"] = True
+                    self.injected.append({
+                        "kind": f"worker_{rule['kind']}", "worker": worker,
+                        "step": int(step)})
+                if rule["kind"] == "dead":
+                    return "dead"
+                state = "hung"
+        return state
+
     # --------------------------------------------------- on-disk corruption
     def corrupt_checkpoint(self, directory: str, mode: str = "truncate"
                            ) -> str:
@@ -182,6 +258,7 @@ class FaultInjector:
             self._file_crash_after = None
             self._files_seen = 0
             self._worker_delays.clear()
+            self._worker_states.clear()
             self.injected.clear()
             self.rng = random.Random(self.seed)
 

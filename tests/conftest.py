@@ -50,6 +50,10 @@ def pytest_configure(config):
         "markers",
         "faults: fault-injection / chaos tests driving the resilience "
         "subsystem (python -m pytest -m faults)")
+    config.addinivalue_line(
+        "markers",
+        "elastic: degraded-mode data parallelism and topology-portable "
+        "resharded-resume tests (python -m pytest -m elastic)")
 
 
 def pytest_collection_modifyitems(config, items):

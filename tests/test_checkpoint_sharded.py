@@ -132,6 +132,162 @@ def test_restore_missing_dir_raises(tmp_path):
         restore_checkpoint(str(tmp_path / "nope"))
 
 
+# ------------------------------------------------- topology-portable restore
+def _forbid_full_gather(monkeypatch):
+    """The resharded-restore acceptance: with mesh= given, the full-leaf
+    host assembly must never run (docs/resilience.md resharding
+    semantics)."""
+    from deeplearning4j_tpu.parallel import checkpoint as cp
+
+    def boom(*a, **k):
+        raise AssertionError("full gather-to-host on the resharded path")
+
+    monkeypatch.setattr(cp, "_assemble", boom)
+
+
+def _save_2x2_layout(tmp_path):
+    """A (K=4, 2x2 data x model) checkpoint with every sharding flavor:
+    data-sharded, model-sharded, and replicated leaves."""
+    devs = jax.devices()
+    mesh = backend.default_mesh(data=2, model=2, devices=devs[:4])
+    trees = {
+        "W": jax.device_put(
+            np.arange(16 * 6, dtype=np.float32).reshape(16, 6),
+            NamedSharding(mesh, P("data"))),
+        "V": jax.device_put(
+            np.arange(8 * 8, dtype=np.float32).reshape(8, 8),
+            NamedSharding(mesh, P(None, "model"))),
+        "b": jax.device_put(np.arange(8, dtype=np.float32),
+                            NamedSharding(mesh, P())),
+    }
+
+    class Fake:
+        params = {"l": trees}
+        updater_state = {}
+        net_state = {}
+        iteration = 5
+        _keys = None
+
+    save_checkpoint(str(tmp_path), Fake())
+    return {k: np.asarray(v) for k, v in trees.items()}
+
+
+@pytest.mark.elastic
+def test_resharded_restore_matrix(tmp_path, monkeypatch):
+    """Save on (K=4, 2x2 layout); resume on K=2, K=8, 1x8, and a single
+    device — bit-identical to the gather-to-host reference path, with the
+    full-leaf gather forbidden."""
+    ref = _save_2x2_layout(tmp_path)
+    # reference path: explicit gather-to-host (no mesh)
+    host_params, _, _, _ = restore_checkpoint(str(tmp_path))
+    for k, v in ref.items():
+        np.testing.assert_array_equal(np.asarray(host_params["l"][k]), v)
+
+    devs = jax.devices()
+    _forbid_full_gather(monkeypatch)
+    for target in (
+            backend.default_mesh(data=2, devices=devs[:2]),        # K=4->2
+            backend.default_mesh(data=8, devices=devs),            # K=4->8
+            backend.default_mesh(data=1, model=8, devices=devs),   # 1x8
+            backend.default_mesh(data=1, devices=devs[:1])):       # single
+        params, _, _, it = restore_checkpoint(str(tmp_path), mesh=target)
+        assert it == 5
+        for k, v in ref.items():
+            got = params["l"][k]
+            assert isinstance(got.sharding, NamedSharding)
+            np.testing.assert_array_equal(np.asarray(got), v)
+
+
+@pytest.mark.elastic
+def test_resharded_restore_reads_each_member_once(tmp_path, monkeypatch):
+    """A target mesh finer than the saver must not re-read saved npz
+    members once per intersecting target shard (NpzFile decompresses the
+    whole member on every access): restoring a 2x2-saved checkpoint on
+    K=8 reads each shard member exactly once."""
+    _save_2x2_layout(tmp_path)
+    reads = []
+    orig = np.lib.npyio.NpzFile.__getitem__
+
+    def counting(self, key):
+        reads.append(key)
+        return orig(self, key)
+
+    monkeypatch.setattr(np.lib.npyio.NpzFile, "__getitem__", counting)
+    restore_checkpoint(str(tmp_path),
+                       mesh=backend.default_mesh(data=8))
+    data_members = [k for k in reads if "@" in k]
+    assert data_members, "no shard members read"
+    assert len(data_members) == len(set(data_members)), (
+        f"members re-read: {sorted(set(k for k in data_members if data_members.count(k) > 1))}")
+
+
+@pytest.mark.elastic
+def test_resharded_2x4_to_1x8_is_device_side(tmp_path, monkeypatch):
+    """Same device count (2x4 -> 1x8): the saved shards load in the SAVED
+    layout and ONE device-side resharding (collective permutes) lands the
+    target layout — counted via the reshard seam, full gather forbidden."""
+    from deeplearning4j_tpu.parallel import checkpoint as cp
+
+    devs = jax.devices()
+    mesh_save = backend.default_mesh(data=2, model=4, devices=devs)
+    W = jax.device_put(
+        np.arange(16 * 8, dtype=np.float32).reshape(16, 8),
+        NamedSharding(mesh_save, P("data", "model")))
+
+    class Fake:
+        params = {"l": {"W": W}}
+        updater_state = {}
+        net_state = {}
+        iteration = 1
+        _keys = None
+
+    save_checkpoint(str(tmp_path), Fake())
+
+    calls = []
+    orig = cp._reshard_on_device
+    monkeypatch.setattr(cp, "_reshard_on_device",
+                        lambda a, t: calls.append(1) or orig(a, t))
+    _forbid_full_gather(monkeypatch)
+    target = backend.default_mesh(data=1, model=8, devices=devs)
+    params, _, _, _ = restore_checkpoint(str(tmp_path), mesh=target)
+    got = params["l"]["W"]
+    assert len(calls) == 1
+    assert got.sharding.spec == P("data", "model")
+    np.testing.assert_array_equal(
+        np.asarray(got), np.arange(16 * 8, dtype=np.float32).reshape(16, 8))
+    # same-topology restore takes direct placement, not the permute
+    calls.clear()
+    restore_checkpoint(str(tmp_path), mesh=mesh_save)
+    assert not calls
+
+
+@pytest.mark.elastic
+def test_manager_resume_onto_different_topology(tmp_path):
+    """CheckpointManager end to end: train + save under one mesh, resume a
+    fresh facade on a smaller mesh — params/updater/iteration/RNG all
+    bit-identical to a same-mesh restore."""
+    from deeplearning4j_tpu.resilience import CheckpointManager
+
+    mesh_a = backend.default_mesh()                       # 8-way data
+    net = _net()
+    rs = np.random.RandomState(3)
+    x, y = _batches(rs, 32)
+    DistributedNetwork(net, SyncTrainingMaster(mesh=mesh_a)).fit(
+        ListDataSetIterator(DataSet(x, y), 16))
+    cm = CheckpointManager(str(tmp_path), async_save=False)
+    cm.save(net)
+
+    mesh_b = backend.default_mesh(data=2, devices=jax.devices()[:2])
+    fresh = _net(seed=777)
+    assert cm.resume(fresh, mesh=mesh_b) == net.iteration
+    np.testing.assert_array_equal(np.asarray(fresh.params_to_vector()),
+                                  np.asarray(net.params_to_vector()))
+    # and training continues on the new topology
+    DistributedNetwork(fresh, SyncTrainingMaster(mesh=mesh_b)).fit(
+        ListDataSetIterator(DataSet(x, y), 16))
+    assert fresh.iteration == net.iteration + 2
+
+
 def test_multi_host_manifests_merge(tmp_path):
     """A cross-host-sharded leaf: each process's manifest lists only its own
     shards (process-qualified keys); restore must union them."""
