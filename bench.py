@@ -34,17 +34,6 @@ FALLBACK_BASELINES = {
     "lstm_chars_per_sec": None,
 }
 
-# peak dense matmul throughput per chip, bf16 FLOP/s (public spec sheets)
-PEAK_FLOPS = {
-    "TPU v6": 918e12,
-    "TPU v5p": 459e12,
-    "TPU v5": 197e12,   # v5 lite (v5e)
-    "TPU v4": 275e12,
-    "TPU v3": 123e12,
-    "TPU v2": 46e12,
-}
-
-
 def _load_baselines():
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "baseline_cpu.json")
@@ -101,11 +90,14 @@ def _devices_with_retry():
 
 
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for prefix, peak in PEAK_FLOPS.items():
-        if kind.startswith(prefix):
-            return peak
-    return 0.0
+    """Spec-sheet peak only (``observability.profiling.PEAK_FLOPS`` owns
+    the table): headline MFU and the faster-than-peak plausibility check
+    both use 0.0 on backends without a published number; the CPU-estimate
+    MFU lives in the observability.performance section instead."""
+    from deeplearning4j_tpu.observability.profiling import peak_flops_for
+
+    peak, source = peak_flops_for(device)
+    return peak if source == "table" else 0.0
 
 
 def _compile_step(jitted, *args):
@@ -565,12 +557,23 @@ def bench_decode(platform, peak):
         fn = jax.jit(build_decode_fn(net, steps, temperature=1.0))
         prompt = jnp.zeros((batch, 1), jnp.int32)
         key = jax.random.PRNGKey(0)
+        # XLA cost analysis of the whole scanned decode program (all
+        # `steps` tokens in one dispatch) — the decode-side FLOP number
+        # the roadmap's continuous-batching work needs a before-value for
+        from deeplearning4j_tpu.observability.profiling import (
+            jit_cost_analysis,
+        )
+
+        cost = jit_cost_analysis(
+            fn, (net.params, net.net_state, carries, prompt, key), {})
+        flops = cost.get("flops") or 0.0
 
         def one():
             ids, _ = fn(net.params, net.net_state, carries, prompt, key)
             return ids
 
-        dt, timing, spread = _checked_time(one, warmup, iters, _sync, 0, 0)
+        dt, timing, spread = _checked_time(one, warmup, iters, _sync,
+                                           flops, peak)
         per_tok = dt / steps
         # HBM the cache streams per decoded token (each layer reads its
         # full K+V cache every step) — the bandwidth story the variants
@@ -585,6 +588,10 @@ def bench_decode(platform, peak):
             "per_token_ms": round(per_tok * 1e3, 4),
             "kv_cache_mb": round(cache_bytes / 1e6, 1),
             "implied_cache_gbps": round(cache_bytes / per_tok / 1e9, 1),
+            "flops_per_scan": flops,
+            "flops_per_token": round(flops / steps, 1) if flops else None,
+            "mfu": (round(flops / dt / peak, 4)
+                    if (flops and peak) else None),
             "timing": timing,
             "spread": spread,
         }
@@ -604,6 +611,9 @@ def bench_decode(platform, peak):
         "dtype": "bfloat16" if platform == "tpu" else "float32",
         "batch": batch,
         "decode_steps": steps,
+        "flops_per_step": mha["flops_per_scan"],
+        "step_ms": round(mha["per_token_ms"] * steps, 2),
+        "flops_source": "xla_cost_analysis",
         "variants": results,
         "gqa_speedup": round(results["gqa2"]["tokens_per_sec"]
                              / mha["tokens_per_sec"], 2),
@@ -990,6 +1000,39 @@ def bench_elastic(platform, peak):
     }
 
 
+def _performance_attribution(metrics, dev):
+    """The observability.performance section: step FLOPs, MFU (spec-sheet
+    peak on TPU, documented CPU estimate otherwise — always labeled), and
+    peak device memory for every bench that reported flops+step time.
+    The before-numbers roadmap items 1/2/5 regress against."""
+    from deeplearning4j_tpu.observability.profiling import (
+        peak_flops_for, peak_memory_snapshot,
+    )
+
+    peak, source = peak_flops_for(dev)
+    per_bench = {}
+    for m in metrics:
+        flops, step_ms = m.get("flops_per_step"), m.get("step_ms")
+        if not (flops and step_ms):
+            continue
+        name = m["metric"].split(" (")[0]
+        mfu = min(1.0, flops / (step_ms / 1e3) / peak) if peak else None
+        per_bench[name] = {
+            "flops_per_step": flops,
+            "step_ms": step_ms,
+            "mfu": round(mfu, 6) if mfu is not None else None,
+            "mfu_source": source,
+        }
+    return {
+        "peak_flops": peak or None,
+        "peak_flops_source": source,
+        "per_bench": per_bench,
+        # end-of-run high-water mark (PJRT peak_bytes_in_use, or the
+        # live-buffer total as a labeled estimate on CPU)
+        "peak_memory": peak_memory_snapshot(),
+    }
+
+
 def main():
     baselines = _load_baselines()
     devices = _devices_with_retry()
@@ -1042,6 +1085,9 @@ def main():
         # phase-timing fields next to the timings
         "observability": {
             "bench_phases": phases.as_dict(),
+            # MFU / step-flops / peak-memory attribution for the train
+            # and decode benches (roadmap items 1/2/5 before-numbers)
+            "performance": _performance_attribution(metrics, dev),
             "registry": get_registry().to_json(),
             # diagnostics: the SLO verdict over everything the run
             # recorded, the merged per-worker view, and how much flight

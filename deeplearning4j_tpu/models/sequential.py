@@ -333,6 +333,13 @@ class MultiLayerNetwork(LazyScoreMixin):
         ``PreemptionHandler`` — commits a priority checkpoint and returns
         cleanly.  ``retry_policy=`` retries transient step failures with
         backoff (docs/resilience.md)."""
+        from deeplearning4j_tpu.observability import profiling
+
+        prof = profiling.active_profiler()
+        if prof is not None:
+            # memory attribution: flight/watchdog dumps show this model's
+            # per-leaf param/updater byte breakdown (weakly held)
+            prof.track_model(self, "MultiLayerNetwork")
         res = None
         if checkpoint_manager is not None or retry_policy is not None:
             from deeplearning4j_tpu.resilience import FitResilience

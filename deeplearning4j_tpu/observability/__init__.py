@@ -15,7 +15,12 @@ from deeplearning4j_tpu.observability.metrics import (
     MetricsRegistry, get_registry, set_registry,
 )
 from deeplearning4j_tpu.observability.tracing import (
-    Span, SpanTracer, get_tracer, set_tracer,
+    Span, SpanTracer, get_tracer, new_trace_id, set_tracer,
+)
+from deeplearning4j_tpu.observability.profiling import (
+    PEAK_FLOPS, StepProfiler, active_profiler, jit_cost_analysis,
+    live_buffer_snapshot, model_memory_breakdown, peak_flops_for,
+    peak_memory_snapshot,
 )
 from deeplearning4j_tpu.observability.recompile import (
     RecompileDetector, compile_counter, fingerprint, instrument,
@@ -42,7 +47,10 @@ from deeplearning4j_tpu.observability.flightrecorder import (
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
     "MetricsRegistry", "get_registry", "set_registry",
-    "Span", "SpanTracer", "get_tracer", "set_tracer",
+    "Span", "SpanTracer", "get_tracer", "new_trace_id", "set_tracer",
+    "PEAK_FLOPS", "StepProfiler", "active_profiler", "jit_cost_analysis",
+    "live_buffer_snapshot", "model_memory_breakdown", "peak_flops_for",
+    "peak_memory_snapshot",
     "RecompileDetector", "compile_counter", "fingerprint", "instrument",
     "DeviceMemoryMonitor", "device_memory_stats", "sample_once",
     "PhaseTimers", "FitTelemetry", "fit_telemetry", "ServingMetrics",

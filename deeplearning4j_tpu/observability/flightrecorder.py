@@ -149,6 +149,16 @@ def dump_flight_report(path: str, reason: str, *, recorder=None, tracer=None,
     except Exception as e:
         lines.append({"record": "error", "section": "device_memory",
                       "error": repr(e)})
+    try:
+        # WHAT holds the memory: live buffers by shape/dtype plus the
+        # per-leaf breakdown of any profiler-tracked model
+        from deeplearning4j_tpu.observability import profiling
+
+        lines.append({"record": "memory_attribution",
+                      **profiling.memory_attribution()})
+    except Exception as e:
+        lines.append({"record": "error", "section": "memory_attribution",
+                      "error": repr(e)})
     with open(path, "w") as f:
         for obj in lines:
             f.write(json.dumps(obj, default=str) + "\n")
@@ -268,6 +278,14 @@ class StepWatchdog:
             "(hang) and crash paths (exception)", labels=("reason",)
         ).inc(reason=reason)
         self.dumps.append(path)
+        try:
+            # capture-on-watchdog: arm the installed profiler so the next
+            # step that runs after this dump gets a full trace capture
+            from deeplearning4j_tpu.observability import profiling
+
+            profiling.notify_watchdog(reason)
+        except Exception:
+            pass
         return path
 
     # ------------------------------------------------------------ lifecycle
@@ -314,24 +332,43 @@ def get_watchdog() -> Optional[StepWatchdog]:
 def step_guard(name: str, **attrs):
     """The one hook fit loops, masters, and the serving dispatcher wrap
     their step/dispatch in: records ``step_begin``/``step_end`` (or
-    ``step_error``) flight events and arms the installed watchdog for the
-    duration.  Dump-on-exception lives in ``crash_dump`` (called once at
-    the fit-loop level) so a failing step is recorded here but reported
-    exactly once there."""
+    ``step_error``) flight events, arms the installed watchdog for the
+    duration, and — when a ``StepProfiler`` is installed — opens the
+    per-step attribution frame that turns dispatched FLOPs into
+    MFU/roofline gauges (and trace captures on trigger).  Dump-on-
+    exception lives in ``crash_dump`` (called once at the fit-loop level)
+    so a failing step is recorded here but reported exactly once there."""
     rec = get_flight_recorder()
     rec.record("step_begin", name=name, **attrs)
     wd = _active_watchdog
     token = wd.arm(name, **attrs) if wd is not None else None
+    prof = frame = None
+    try:
+        from deeplearning4j_tpu.observability import profiling
+
+        prof = profiling.active_profiler()
+        if prof is not None:
+            frame = prof.on_step_begin(name, attrs)
+    except Exception:   # a broken profiler must never break training
+        prof = frame = None
     t0 = time.perf_counter()
+    err = None
     try:
         yield
     except BaseException as e:
+        err = e
         rec.record("step_error", name=name, error=repr(e), **attrs)
         raise
     else:
         rec.record("step_end", name=name,
                    seconds=round(time.perf_counter() - t0, 6), **attrs)
     finally:
+        if prof is not None and frame is not None:
+            try:
+                prof.on_step_end(name, time.perf_counter() - t0, attrs,
+                                 frame, error=err)
+            except Exception:
+                pass
         if wd is not None:
             wd.disarm(token)
 

@@ -166,6 +166,18 @@ class StragglerDetector:
                 self._last_warn[worker] = now
         self._m_stragglers.inc(component=self.component, worker=worker)
         if should_warn:
+            try:
+                # performance attribution: a straggler verdict arms the
+                # installed StepProfiler to capture the next step, so the
+                # trace shows what the degraded window actually did.
+                # Gated on the rate-limited warning path: a persistently
+                # slow worker must not re-arm a capture every window.
+                from deeplearning4j_tpu.observability import profiling
+
+                profiling.notify_straggler(self.component, worker)
+            except Exception:
+                pass
+        if should_warn:
             breakdown = ""
             if phases:
                 parts = ", ".join(f"{k}={v * 1e3:.1f}ms"

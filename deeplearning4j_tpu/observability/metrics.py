@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import math
 import threading
+import time
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 # Latency buckets in SECONDS (Prometheus base unit), spanning the sub-ms
@@ -126,7 +127,7 @@ class Histogram:
     """
 
     __slots__ = ("_lock", "buckets", "_bucket_counts", "_sum", "_count",
-                 "_min", "_max")
+                 "_min", "_max", "_exemplars")
 
     def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS):
         self._lock = threading.Lock()
@@ -136,8 +137,13 @@ class Histogram:
         self._count = 0
         self._min = math.inf
         self._max = -math.inf
+        # bucket index -> last exemplar that landed there (OpenMetrics
+        # style: a trace id sampled onto the latency distribution, so a
+        # p99 spike comes with a concrete request to go look at).  Index
+        # len(buckets) is the +Inf overflow bucket.
+        self._exemplars: Dict[int, Dict[str, Any]] = {}
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         value = float(value)
         with self._lock:
             self._sum += value
@@ -146,10 +152,15 @@ class Histogram:
                 self._min = value
             if value > self._max:
                 self._max = value
+            idx = len(self.buckets)
             for i, b in enumerate(self.buckets):
                 if value <= b:
                     self._bucket_counts[i] += 1
+                    idx = i
                     break
+            if exemplar is not None:
+                self._exemplars[idx] = {"trace_id": str(exemplar),
+                                        "value": value, "ts": time.time()}
 
     def time(self):
         """Context manager observing the elapsed seconds of the block."""
@@ -180,12 +191,17 @@ class Histogram:
         with self._lock:
             count = self._count
             bucket_counts = list(self._bucket_counts)
+            exemplars = {i: dict(e) for i, e in self._exemplars.items()}
             out = {
                 "count": count,
                 "sum": self._sum,
                 "min": self._min if count else None,
                 "max": self._max if count else None,
             }
+        out["exemplars"] = {
+            ("+Inf" if i == len(self.buckets)
+             else _fmt_value(self.buckets[i])): e
+            for i, e in exemplars.items()}
         cum, running = [], 0
         for b, c in zip(self.buckets, bucket_counts):
             running += c
@@ -199,9 +215,13 @@ class Histogram:
         """(upper_bound, cumulative_count) pairs, +Inf last."""
         return self.snapshot()["cumulative_buckets"]
 
+    def exemplars(self) -> Dict[str, Dict[str, Any]]:
+        """Bucket upper-bound -> last exemplar sampled into that bucket."""
+        return self.snapshot()["exemplars"]
+
     def to_dict(self) -> Dict[str, Any]:
         snap = self.snapshot()
-        return {
+        out = {
             "count": snap["count"],
             "sum": snap["sum"],
             "min": snap["min"],
@@ -211,6 +231,9 @@ class Histogram:
                 for b, c in zip(self.buckets, snap["bucket_counts"])
             },
         }
+        if snap["exemplars"]:
+            out["exemplars"] = snap["exemplars"]
+        return out
 
 
 class _HistogramTimer:
@@ -279,8 +302,9 @@ class MetricFamily:
     def set_function(self, fn, **labels):
         (self.labels(**labels) if labels else self._default()).set_function(fn)
 
-    def observe(self, value, **labels):
-        (self.labels(**labels) if labels else self._default()).observe(value)
+    def observe(self, value, exemplar=None, **labels):
+        (self.labels(**labels) if labels
+         else self._default()).observe(value, exemplar=exemplar)
 
     def time(self, **labels):
         return (self.labels(**labels) if labels else self._default()).time()

@@ -25,6 +25,8 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from deeplearning4j_tpu.observability import profiling
+
 logger = logging.getLogger("deeplearning4j_tpu.observability")
 
 _COMPILES = "dl4j_compiles_total"
@@ -66,6 +68,16 @@ def fingerprint(args: Tuple, kwargs: Dict) -> Tuple:
     return (treedef, tuple(_leaf_sig(l) for l in leaves))
 
 
+def _fmt_signature(sig: Tuple, max_leaves: int = 12) -> str:
+    """Readable one-line form of a fingerprint's leaf signatures
+    (``f32[128,784], f32[128,10], …``) for flight-recorder records."""
+    _treedef, leaves = sig
+    parts = [_fmt_leaf_sig(s) for s in leaves[:max_leaves]]
+    if len(leaves) > max_leaves:
+        parts.append(f"… {len(leaves) - max_leaves} more")
+    return ", ".join(parts)
+
+
 def _leaf_paths(args: Tuple, kwargs: Dict) -> List[str]:
     """Human-readable path per leaf, same order as ``fingerprint``."""
     import jax
@@ -97,6 +109,12 @@ class RecompileDetector:
         self._last: Optional[Tuple] = None
         self.compile_count = 0
         self.recompile_count = 0  # new signatures after the first
+        # signature -> XLA cost analysis (filled when a profiler is
+        # installed; see check(cost_fn=)).  last_cost is the CURRENT
+        # signature's entry — _InstrumentedJit reads it right after
+        # check() to attribute the dispatch's FLOPs to the step.
+        self._cost_by_sig: Dict[Tuple, Dict] = {}
+        self.last_cost: Optional[Dict] = None
         reg = registry if registry is not None else get_registry()
         self._m_compiles = compile_counter(name, reg)
         self._m_recompiles = reg.counter(
@@ -104,14 +122,22 @@ class RecompileDetector:
             "(shape/dtype/sharding churn)", labels=("fn",)
         ).labels(fn=name)
 
-    def check(self, args: Any, kwargs: Dict, expected: bool = False) -> bool:
+    def check(self, args: Any, kwargs: Dict, expected: bool = False,
+              cost_fn: Optional[Callable[[], Dict]] = None) -> bool:
         """Record this call's signature (``args`` is any pytree — a tuple
         of positional args, or a position-keyed dict when the wrapper
         subsets by ``argnums``); returns True when it is new (i.e. this
         call compiles).  ``expected=True`` marks a PLANNED compile (e.g.
         serving AOT warmup sweeping its bucket shapes): it still counts
         in ``dl4j_compiles_total`` but does not warn or count as a
-        recompile — those alert only on unplanned signature churn."""
+        recompile — those alert only on unplanned signature churn.
+
+        ``cost_fn`` (profiler seam): called once per NEW signature to
+        fetch its XLA cost analysis; the result is cached per signature,
+        exposed as ``last_cost`` for every later call with that
+        signature, and an UNEXPECTED recompile dumps the new abstract
+        signature with its flops/bytes delta vs the evicted one into the
+        flight recorder — not just a counter bump."""
         sig = fingerprint(args, kwargs)
         with self._lock:
             known = sig in self._seen
@@ -120,8 +146,19 @@ class RecompileDetector:
                 self._seen[sig] = self.compile_count
                 self._m_compiles.inc()
             prev, self._last = self._last, sig
-        if known:
-            return False
+            if known:
+                self.last_cost = self._cost_by_sig.get(sig)
+                return False
+        # cost analysis OUTSIDE the lock: it lowers + compiles
+        cost: Optional[Dict] = None
+        if cost_fn is not None:
+            try:
+                cost = cost_fn() or {}
+            except Exception:
+                cost = {}
+            with self._lock:
+                self._cost_by_sig[sig] = cost
+        self.last_cost = cost
         # compiles land in the flight record too: "what happened right
         # before the hang" is usually a compile or a shape change
         from deeplearning4j_tpu.observability.flightrecorder import (
@@ -134,8 +171,40 @@ class RecompileDetector:
         if prev is not None and not expected:
             self.recompile_count += 1
             self._m_recompiles.inc()
-            self.warn(self._delta_message(prev, sig, args, kwargs))
+            msg = self._delta_message(prev, sig, args, kwargs)
+            self.warn(msg)
+            self._record_recompile_event(prev, sig, cost)
         return True
+
+    def _record_recompile_event(self, prev: Tuple, new: Tuple,
+                                cost: Optional[Dict]) -> None:
+        """The satellite-grade recompile record: new abstract signature +
+        cost-analysis summary (flops/bytes delta vs the evicted
+        signature) into the flight recorder.  Cost fields appear when a
+        profiler had analysis enabled for both signatures."""
+        from deeplearning4j_tpu.observability.flightrecorder import (
+            get_flight_recorder,
+        )
+
+        ev: Dict[str, Any] = {
+            "fn": self.name, "ordinal": self.compile_count,
+            "signature": _fmt_signature(new),
+            "evicted_signature": _fmt_signature(prev),
+        }
+        with self._lock:
+            prev_cost = self._cost_by_sig.get(prev)
+        if cost:
+            ev["flops"] = cost.get("flops")
+            ev["bytes_accessed"] = cost.get("bytes_accessed")
+        if prev_cost:
+            ev["evicted_flops"] = prev_cost.get("flops")
+            ev["evicted_bytes_accessed"] = prev_cost.get("bytes_accessed")
+        if cost and prev_cost:
+            ev["flops_delta"] = ((cost.get("flops") or 0.0)
+                                 - (prev_cost.get("flops") or 0.0))
+            ev["bytes_delta"] = ((cost.get("bytes_accessed") or 0.0)
+                                 - (prev_cost.get("bytes_accessed") or 0.0))
+        get_flight_recorder().record("recompile", **ev)
 
     def _delta_message(self, old: Tuple, new: Tuple, args, kwargs) -> str:
         old_def, old_leaves = old
@@ -174,7 +243,14 @@ class _InstrumentedJit:
     carries), because the params/optimizer-state pytrees cannot change
     abstract shape between steps (each step's inputs are the previous
     step's outputs) and fingerprinting hundreds of param leaves every
-    iteration is measurable host overhead."""
+    iteration is measurable host overhead.
+
+    Profiler seam: while a ``StepProfiler`` with cost analysis is
+    installed, each NEW signature is cost-analyzed (abstract lowering of
+    the FULL argument list — safe with donation, nothing executes) and
+    every call reports its signature's cached flops/bytes to the profiler
+    (``note_dispatch``), which rolls them into the step's MFU/roofline
+    gauges at the ``step_guard`` boundary."""
 
     __slots__ = ("_fn", "detector", "_argnums")
 
@@ -185,13 +261,20 @@ class _InstrumentedJit:
         self._argnums = argnums
 
     def __call__(self, *args, **kwargs):
+        prof = profiling.active_profiler()
+        cost_fn = None
+        if prof is not None and prof.cost_analysis:
+            fn = self._fn
+            cost_fn = lambda: profiling.jit_cost_analysis(fn, args, kwargs)
         if self._argnums is None:
-            self.detector.check(args, kwargs)
+            self.detector.check(args, kwargs, cost_fn=cost_fn)
         else:
             # dict keyed by the ORIGINAL position so delta paths stay
             # meaningful ("args[4]: f32[32,8] -> f32[20,8]")
             sel = {i: args[i] for i in self._argnums if i < len(args)}
-            self.detector.check(sel, kwargs)
+            self.detector.check(sel, kwargs, cost_fn=cost_fn)
+        if prof is not None:
+            prof.note_dispatch(self.detector.name, self.detector.last_cost)
         return self._fn(*args, **kwargs)
 
     def __getattr__(self, item):

@@ -1,0 +1,234 @@
+"""Bench regression sentinel: compare a fresh ``bench_full.json`` against a
+committed baseline with per-metric direction + tolerance rules.
+
+Until now the perf trajectory was advisory: ``bench.py`` wrote numbers, a
+human eyeballed them.  This module gives it teeth — a rule says which
+field of which bench entry matters, which DIRECTION is good, and how much
+relative slack the (noisy, CPU-jittered) measurement gets before a change
+counts as a regression.  ``scripts/check_bench_regression.py`` wraps it as
+a CI gate: exit 0 clean, exit 1 on any regression.
+
+STDLIB ONLY on purpose: the checker script must run in milliseconds with
+no jax import, and the module is imported by file path from ``scripts/``
+(same pattern as ``check_metrics_docs.py``).
+
+Rule addressing: bench entries live in ``doc["all"]``, each with a
+``metric`` name like ``"Decode tokens/sec (d256 L4, b4, ...)"`` — the
+part after `` (`` encodes the config and changes across platforms, so
+rules match on the PREFIX before it.  ``field`` is a dotted path inside
+the entry (``"value"``, ``"variants.gqa2_rolling.tokens_per_sec"``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+HIGHER = "higher"   # bigger is better (throughput)
+LOWER = "lower"     # smaller is better (latency, step time)
+
+
+class Rule:
+    """One metric's regression policy."""
+
+    __slots__ = ("metric", "field", "direction", "tolerance", "required")
+
+    def __init__(self, metric: str, field: str = "value",
+                 direction: str = HIGHER, tolerance: float = 0.15,
+                 required: bool = True):
+        if direction not in (HIGHER, LOWER):
+            raise ValueError(
+                f"direction must be {HIGHER!r} or {LOWER!r}, got {direction!r}")
+        if tolerance < 0:
+            raise ValueError(f"tolerance must be >= 0, got {tolerance}")
+        self.metric = str(metric)
+        self.field = str(field)
+        self.direction = direction
+        self.tolerance = float(tolerance)
+        self.required = bool(required)
+
+    @property
+    def key(self) -> str:
+        return f"{self.metric} :: {self.field}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"metric": self.metric, "field": self.field,
+                "direction": self.direction, "tolerance": self.tolerance,
+                "required": self.required}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Rule":
+        unknown = set(d) - {"metric", "field", "direction", "tolerance",
+                            "required"}
+        if unknown:
+            raise ValueError(f"unknown rule keys: {sorted(unknown)}")
+        if "metric" not in d:
+            raise ValueError(f"rule needs a 'metric': {d!r}")
+        return Rule(d["metric"], d.get("field", "value"),
+                    d.get("direction", HIGHER), d.get("tolerance", 0.15),
+                    d.get("required", True))
+
+
+# The committed policy over bench_full.json.  Tolerances are wide (0.4)
+# because the CPU bench's run-to-run spread reaches ~25% (bench.py
+# SPREAD_THRESHOLD discussion); the sentinel is for collapses, not jitter.
+DEFAULT_RULES: List[Rule] = [
+    Rule("ResNet-50 images/sec/chip", tolerance=0.4),
+    Rule("LeNet-MNIST train step time", direction=LOWER, tolerance=0.4),
+    Rule("GravesLSTM char-LM throughput", tolerance=0.4),
+    Rule("Transformer char-LM tokens/sec", tolerance=0.4),
+    Rule("Decode tokens/sec", tolerance=0.4),
+    Rule("Decode tokens/sec", field="variants.gqa2_rolling.tokens_per_sec",
+         tolerance=0.4, required=False),
+    Rule("Long-context train tokens/sec", tolerance=0.4),
+    Rule("Serving rows/sec", tolerance=0.4),
+    Rule("Serving rows/sec", field="p99_ms", direction=LOWER, tolerance=1.0,
+         required=False),
+    # zero-compile contract: the baseline is 0, so ANY steady-state
+    # compile regresses regardless of tolerance (0 * (1+tol) == 0)
+    Rule("Serving rows/sec", field="steady_state_compiles", direction=LOWER,
+         tolerance=0.0, required=False),
+    Rule("Checkpoint save throughput", tolerance=0.4),
+    Rule("Elastic DP samples/sec", tolerance=0.4),
+    Rule("Elastic DP samples/sec", field="degraded_vs_lockstep_speedup",
+         tolerance=0.5, required=False),
+]
+
+
+def load_rules(path: str) -> List[Rule]:
+    """Rules from a JSON file: a list of rule dicts (see Rule.from_dict)."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: rules file must be a JSON list")
+    return [Rule.from_dict(d) for d in data]
+
+
+# ------------------------------------------------------------- extraction
+def _find_entry(doc: Dict[str, Any], metric_prefix: str) -> Optional[Dict]:
+    for entry in doc.get("all", []) or []:
+        if str(entry.get("metric", "")).startswith(metric_prefix):
+            return entry
+    return None
+
+
+def _get_field(entry: Dict[str, Any], dotted: str) -> Optional[float]:
+    cur: Any = entry
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool) or not isinstance(cur, (int, float)):
+        return None
+    return float(cur)
+
+
+def extract(doc: Dict[str, Any], rule: Rule) -> Optional[float]:
+    entry = _find_entry(doc, rule.metric)
+    if entry is None:
+        return None
+    return _get_field(entry, rule.field)
+
+
+# -------------------------------------------------------------- comparison
+class Verdict:
+    """One rule's outcome: ``status`` in {"ok", "improved", "regressed",
+    "missing", "no_baseline"}."""
+
+    __slots__ = ("rule", "status", "baseline", "fresh", "limit", "detail")
+
+    def __init__(self, rule: Rule, status: str, baseline, fresh, limit,
+                 detail: str):
+        self.rule = rule
+        self.status = status
+        self.baseline = baseline
+        self.fresh = fresh
+        self.limit = limit
+        self.detail = detail
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"metric": self.rule.metric, "field": self.rule.field,
+                "direction": self.rule.direction,
+                "tolerance": self.rule.tolerance, "status": self.status,
+                "baseline": self.baseline, "fresh": self.fresh,
+                "limit": self.limit, "detail": self.detail}
+
+
+class Report:
+    def __init__(self, verdicts: List[Verdict]):
+        self.verdicts = verdicts
+
+    @property
+    def regressions(self) -> List[Verdict]:
+        return [v for v in self.verdicts if v.status == "regressed"]
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.regressions else 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"regressed": len(self.regressions),
+                "checked": len(self.verdicts),
+                "verdicts": [v.to_dict() for v in self.verdicts]}
+
+    def format(self) -> str:
+        lines = []
+        for v in self.verdicts:
+            mark = {"ok": "ok       ", "improved": "improved ",
+                    "regressed": "REGRESSED", "missing": "missing  ",
+                    "no_baseline": "skipped  "}[v.status]
+            lines.append(f"{mark} {v.rule.key}: {v.detail}")
+        n = len(self.regressions)
+        lines.append(f"{'FAIL' if n else 'PASS'}: {n} regression(s) in "
+                     f"{len(self.verdicts)} checked rule(s)")
+        return "\n".join(lines)
+
+
+def compare(baseline: Dict[str, Any], fresh: Dict[str, Any],
+            rules: Optional[List[Rule]] = None) -> Report:
+    """Evaluate every rule: a fresh value past ``baseline * (1 ± tol)``
+    in the BAD direction regresses; a missing fresh value regresses when
+    the rule is ``required``; a MISSING baseline skips the rule
+    (``no_baseline`` — there is nothing to hold the line against).  A
+    zero baseline is enforced, not skipped: with ``direction=lower`` and
+    ``tolerance=0`` it means "any increase regresses" — the
+    steady-state-compiles contract depends on exactly that."""
+    verdicts: List[Verdict] = []
+    for rule in (rules if rules is not None else DEFAULT_RULES):
+        base = extract(baseline, rule)
+        new = extract(fresh, rule)
+        if base is None:
+            verdicts.append(Verdict(rule, "no_baseline", None, new, None,
+                                    "no baseline value"))
+            continue
+        if new is None:
+            status = "regressed" if rule.required else "missing"
+            verdicts.append(Verdict(
+                rule, status, base, None, None,
+                "value missing from fresh run"
+                + ("" if rule.required else " (optional)")))
+            continue
+        if rule.direction == HIGHER:
+            limit = base * (1.0 - rule.tolerance)
+            regressed = new < limit
+            improved = new > base
+        else:
+            limit = base * (1.0 + rule.tolerance)
+            regressed = new > limit
+            improved = new < base
+        status = ("regressed" if regressed
+                  else "improved" if improved else "ok")
+        arrow = "<" if rule.direction == HIGHER else ">"
+        detail = (f"fresh {new:g} vs baseline {base:g} "
+                  f"(fails when {arrow} {limit:g})")
+        verdicts.append(Verdict(rule, status, base, new, limit, detail))
+    return Report(verdicts)
+
+
+def check_files(baseline_path: str, fresh_path: str,
+                rules: Optional[List[Rule]] = None) -> Report:
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    with open(fresh_path) as f:
+        fresh = json.load(f)
+    return compare(baseline, fresh, rules)

@@ -9,12 +9,20 @@ phase-attribution SparkNet/DeepSpark-style throughput tuning needs).  For
 ``jax.profiler.TraceAnnotation`` so spans line up with XLA ops in the
 TensorBoard profile, and ``SpanTracer.profile(log_dir)`` brackets a whole
 region with ``jax.profiler.start_trace``/``stop_trace``.
+
+Request tracing: serving mints (or accepts via ``X-Request-Id``) a
+``trace_id`` per request and stamps it on the per-stage spans
+(``serving_request`` / ``serving_queue_wait`` / ``serving_execute``), so
+``spans_for_trace(trace_id)`` answers "where did THIS request's time go".
+``export_chrome_trace`` renders any span set as Chrome-trace JSON
+(loadable in ``chrome://tracing`` / Perfetto).
 """
 
 from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -22,20 +30,28 @@ from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 
+def new_trace_id() -> str:
+    """A 16-hex-char request trace id (random; no global coordination)."""
+    return os.urandom(8).hex()
+
+
 class Span:
     """One finished (or in-flight) span."""
 
     __slots__ = ("name", "span_id", "parent_id", "start_ns", "end_ns",
-                 "attrs")
+                 "attrs", "thread")
 
     def __init__(self, name: str, span_id: int, parent_id: Optional[int],
-                 start_ns: int, attrs: Dict[str, Any]):
+                 start_ns: int, attrs: Dict[str, Any],
+                 thread: Optional[str] = None):
         self.name = name
         self.span_id = span_id
         self.parent_id = parent_id
         self.start_ns = start_ns
         self.end_ns: Optional[int] = None
         self.attrs = attrs
+        self.thread = (thread if thread is not None
+                       else threading.current_thread().name)
 
     @property
     def duration_ns(self) -> Optional[int]:
@@ -57,12 +73,14 @@ class Span:
             "end_ns": self.end_ns,
             "duration_ns": self.duration_ns,
             "attrs": self.attrs,
+            "thread": self.thread,
         }
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Span":
         s = Span(d["name"], d["span_id"], d.get("parent_id"),
-                 d["start_ns"], d.get("attrs") or {})
+                 d["start_ns"], d.get("attrs") or {},
+                 thread=d.get("thread") or "unknown")
         s.end_ns = d.get("end_ns")
         return s
 
@@ -176,6 +194,36 @@ class SpanTracer:
 
                 jax.profiler.stop_trace()
 
+    def record_span(self, name: str, start_ns: int, end_ns: int,
+                    **attrs) -> Span:
+        """Record an already-timed span directly (no stack involvement):
+        the batcher uses this for queue-wait and execute stages whose
+        start happened on a different thread than their end.  Clocks are
+        ``perf_counter_ns`` like everything else here."""
+        s = Span(name, next(self._ids), None, int(start_ns), attrs)
+        s.end_ns = int(end_ns)
+        with self._lock:
+            if len(self._finished) == self._finished.maxlen:
+                self.dropped += 1
+            self._finished.append(s)
+        return s
+
+    # -------------------------------------------------------------- queries
+    def spans_for_trace(self, trace_id: str) -> List[Span]:
+        """Finished spans stamped with ``trace_id=`` (request tracing):
+        the per-stage breakdown of one serving request."""
+        return [s for s in self.spans()
+                if s.attrs.get("trace_id") == trace_id]
+
+    def spans_between(self, start_ns: int, end_ns: int) -> List[Span]:
+        """Finished spans overlapping the [start_ns, end_ns) window (the
+        profiler's capture export)."""
+        out = []
+        for s in self.spans():
+            if s.start_ns < end_ns and (s.end_ns or end_ns) > start_ns:
+                out.append(s)
+        return out
+
     # ------------------------------------------------------------- export
     def spans(self) -> List[Span]:
         with self._lock:
@@ -191,6 +239,38 @@ class SpanTracer:
             for s in spans:
                 f.write(json.dumps(s.to_dict()) + "\n")
         return len(spans)
+
+    def to_chrome_trace(self, spans: Optional[List[Span]] = None) -> Dict:
+        """Render spans as the Chrome trace event format (``ph: "X"``
+        complete events, microsecond clocks) — loadable in
+        ``chrome://tracing`` and Perfetto with no TensorBoard plugin.
+        Threads become trace ``tid``s with ``thread_name`` metadata."""
+        spans = self.spans() if spans is None else spans
+        pid = os.getpid()
+        tids: Dict[str, int] = {}
+        events: List[Dict[str, Any]] = []
+        for s in spans:
+            if s.end_ns is None:
+                continue
+            tid = tids.setdefault(s.thread, len(tids) + 1)
+            events.append({
+                "name": s.name, "cat": "span", "ph": "X",
+                "ts": s.start_ns / 1e3, "dur": (s.end_ns - s.start_ns) / 1e3,
+                "pid": pid, "tid": tid,
+                "args": {**s.attrs, "span_id": s.span_id,
+                         "parent_id": s.parent_id},
+            })
+        meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                 "args": {"name": thread}} for thread, tid in tids.items()]
+        return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str,
+                            spans: Optional[List[Span]] = None) -> int:
+        """Write a Chrome-trace JSON file; returns the span event count."""
+        doc = self.to_chrome_trace(spans)
+        with open(path, "w") as f:
+            json.dump(doc, f, default=str)
+        return sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
 
     @staticmethod
     def read_jsonl(path: str) -> List[Span]:

@@ -31,6 +31,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from deeplearning4j_tpu.observability.tracing import get_tracer
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController, DeadlineExceededError, Request, ShuttingDownError,
 )
@@ -79,7 +80,8 @@ class DynamicBatcher:
         """Admit + enqueue (raises QueueFullError / ShuttingDownError)."""
         key = (req.model, tuple(req.features.shape[1:]))
         with self._cv:
-            self.admission.check_admit(self._queued, self._stop)
+            self.admission.check_admit(self._queued, self._stop,
+                                       trace_id=req.trace_id)
             self._pending.setdefault(key, deque()).append(req)
             self._queued += 1
             if req.deadline < self._earliest_deadline:
@@ -133,7 +135,8 @@ class DynamicBatcher:
             for req in dq:
                 if not req.cancelled:
                     req.deliver(self.admission.shed(
-                        ShuttingDownError, "engine stopped before dispatch"))
+                        ShuttingDownError, "engine stopped before dispatch",
+                        trace_id=req.trace_id))
         self._pending.clear()
         self._queued = 0
 
@@ -155,7 +158,8 @@ class DynamicBatcher:
                     req.deliver(self.admission.shed(
                         DeadlineExceededError,
                         f"deadline passed after "
-                        f"{now - req.enqueued:.3f}s in queue"))
+                        f"{now - req.enqueued:.3f}s in queue",
+                        trace_id=req.trace_id))
                     self._queued -= 1
                 else:
                     if kept is None:
@@ -250,19 +254,43 @@ class DynamicBatcher:
 
     def _dispatch(self, batch: list) -> None:
         now = time.monotonic()
-        if self._metrics is not None:
-            for req in batch:
-                self._metrics.queue_wait.observe(now - req.enqueued)
+        now_ns = time.perf_counter_ns()
+        tracer = get_tracer()
+        for req in batch:
+            req.queue_wait_ns = now_ns - req.enqueued_ns
+            if self._metrics is not None:
+                self._metrics.queue_wait.observe(now - req.enqueued,
+                                                 exemplar=req.trace_id)
+            if req.trace_id:
+                # per-request queue stage: enqueue -> batch dispatch
+                tracer.record_span("serving_queue_wait", req.enqueued_ns,
+                                   now_ns, trace_id=req.trace_id,
+                                   model=req.model, rows=req.rows)
         feats = (batch[0].features if len(batch) == 1
                  else np.concatenate([r.features for r in batch]))
         if self._metrics is not None:
             self._metrics.batch_rows.observe(len(feats))
+        err = None
+        t_ex0 = time.perf_counter_ns()
         try:
             out = self._execute(batch[0].model, feats)
-            pos = 0
-            for req in batch:
+        except Exception as e:  # deliver to waiters; the loop must survive
+            err = e
+        t_ex1 = time.perf_counter_ns()
+        pos = 0
+        for req in batch:
+            req.execute_ns = t_ex1 - t_ex0
+            req.batch_rows = len(feats)
+            if req.trace_id:
+                # the execute stage is shared by the whole micro-batch;
+                # each request gets its own span so a trace-id query
+                # returns the full queue/execute breakdown
+                tracer.record_span(
+                    "serving_execute", t_ex0, t_ex1, trace_id=req.trace_id,
+                    model=req.model, rows=req.rows, batch_rows=len(feats),
+                    **({"error": repr(err)} if err is not None else {}))
+            if err is not None:
+                req.deliver(err)
+            else:
                 req.deliver(out[pos:pos + req.rows])
                 pos += req.rows
-        except Exception as e:  # deliver to waiters; the loop must survive
-            for req in batch:
-                req.deliver(e)

@@ -26,6 +26,7 @@ import os
 import threading
 import time
 import weakref
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -34,6 +35,7 @@ from deeplearning4j_tpu.observability.flightrecorder import (
     get_flight_recorder, step_guard,
 )
 from deeplearning4j_tpu.observability.servingmetrics import ServingMetrics
+from deeplearning4j_tpu.observability.tracing import get_tracer, new_trace_id
 from deeplearning4j_tpu.serving.admission import (
     AdmissionController, DeadlineExceededError, QueueFullError, Request,
     ServingError, ShuttingDownError,
@@ -86,6 +88,12 @@ class ServingEngine:
             metrics=self.metrics)
         self._bind_queue_gauge()
         self._swap_lock = threading.Lock()
+        # trace_id -> per-stage breakdown of recently completed requests
+        # (bounded LRU; O(1) for the access log — the span ring is the
+        # fallback for ids that have aged out of this cache)
+        self._breakdowns: "OrderedDict[str, dict]" = OrderedDict()
+        self._breakdown_lock = threading.Lock()
+        self._breakdown_cap = 2048
 
     def _bind_queue_gauge(self) -> None:
         # weakref: the registry outlives the engine — a strong closure
@@ -122,11 +130,19 @@ class ServingEngine:
 
     # ---------------------------------------------------------------- predict
     def predict(self, features: np.ndarray, model: Optional[str] = None,
-                deadline_s: Optional[float] = None) -> np.ndarray:
+                deadline_s: Optional[float] = None,
+                trace_id: Optional[str] = None) -> np.ndarray:
         """Thread-safe batched inference.  Raises ``QueueFullError``
         (shed), ``ShuttingDownError``, ``DeadlineExceededError``, or the
         model's own failure — bounded by the request deadline either
-        way."""
+        way.
+
+        ``trace_id`` (minted here when absent) rides the request end to
+        end: queue and execute stages record spans stamped with it
+        (``SpanTracer.spans_for_trace``), shed/deadline errors carry it
+        (``.trace_id`` attribute + message), shed flight events name it,
+        and it is sampled as the exemplar onto the latency histogram."""
+        trace_id = trace_id or new_trace_id()
         model = model or self.default_model
         feats = np.asarray(features, np.float32)
         if feats.ndim == 1:
@@ -143,14 +159,41 @@ class ServingEngine:
                     feats.dtype)
                 feats = np.concatenate([feats, pad], axis=1)
         deadline = self.admission.deadline_for(deadline_s)
-        req = Request(feats, model, deadline, orig_seq)
+        req = Request(feats, model, deadline, orig_seq, trace_id=trace_id)
         t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
+        status = "error"
+        try:
+            res = self._predict_wait(req, model, deadline, trace_id, t0)
+            status = "ok"
+            if (orig_seq is not None and res.ndim >= 3
+                    and res.shape[1] > orig_seq):
+                res = res[:, :orig_seq]   # trim time-distributed pad steps
+            return res
+        except ServingError as e:
+            status = e.shed_reason or "error"
+            raise
+        finally:
+            t1_ns = time.perf_counter_ns()
+            get_tracer().record_span(
+                "serving_request", t0_ns, t1_ns,
+                trace_id=trace_id, model=model, rows=req.rows,
+                status=status)
+            self._remember_breakdown(req, trace_id, status,
+                                     (t1_ns - t0_ns) / 1e6)
+
+    def _predict_wait(self, req: Request, model: str, deadline: float,
+                      trace_id: str, t0: float) -> np.ndarray:
+        """Submit + bounded wait + result classification (the predict
+        body; split so ``predict`` can bracket it with the request
+        span)."""
         try:
             self.batcher.submit(req)
         except ServingError as e:
             self.metrics.requests.inc(status="shed")
             get_flight_recorder().record("shed", model=model,
-                                         reason=type(e).__name__)
+                                         reason=type(e).__name__,
+                                         trace_id=trace_id)
             raise
         # +grace so the queue-side deadline purge (which produces the more
         # informative error and owns shed{reason="deadline"}) normally
@@ -162,11 +205,16 @@ class ServingEngine:
             if not req.done.is_set():
                 self.metrics.requests.inc(status="deadline")
                 get_flight_recorder().record("shed", model=model,
-                                             reason="deadline")
-                raise DeadlineExceededError(
+                                             reason="deadline",
+                                             trace_id=trace_id)
+                err = DeadlineExceededError(
                     f"no result within {deadline:.3f}s deadline "
-                    f"(dispatcher dead or engine overloaded)")
-        self.metrics.latency.observe(time.perf_counter() - t0)
+                    f"(dispatcher dead or engine overloaded) "
+                    f"[trace {trace_id}]")
+                err.trace_id = trace_id
+                raise err
+        self.metrics.latency.observe(time.perf_counter() - t0,
+                                     exemplar=trace_id)
         self.metrics.request_rows.observe(req.rows)
         res = req.result[0]
         if isinstance(res, Exception):
@@ -176,12 +224,65 @@ class ServingEngine:
                 self.metrics.requests.inc(status="shed")
             else:
                 self.metrics.requests.inc(status="error")
+            if isinstance(res, ServingError):
+                get_flight_recorder().record("shed", model=model,
+                                             reason=type(res).__name__,
+                                             trace_id=trace_id)
             raise res
         self.metrics.requests.inc(status="ok")
-        if (orig_seq is not None and res.ndim >= 3
-                and res.shape[1] > orig_seq):
-            res = res[:, :orig_seq]   # trim time-distributed pad steps
         return res
+
+    def _remember_breakdown(self, req: Request, trace_id: str, status: str,
+                            total_ms: float) -> None:
+        """Cache the completed request's per-stage timings (stamped on
+        the Request by the batcher) under its trace id — O(1) for the
+        access log, immune to span-ring eviction."""
+        entry = {
+            "trace_id": trace_id,
+            "queue_wait_ms": (None if req.queue_wait_ns is None
+                              else req.queue_wait_ns / 1e6),
+            "execute_ms": (None if req.execute_ns is None
+                           else req.execute_ns / 1e6),
+            "total_ms": total_ms,
+            "status": status,
+            "batch_rows": req.batch_rows,
+            "bucket": (None if not req.batch_rows else self.policy.
+                       bucket_rows(min(int(req.batch_rows),
+                                       self.policy.max_batch))),
+        }
+        with self._breakdown_lock:
+            self._breakdowns[trace_id] = entry
+            self._breakdowns.move_to_end(trace_id)
+            while len(self._breakdowns) > self._breakdown_cap:
+                self._breakdowns.popitem(last=False)
+
+    def request_breakdown(self, trace_id: str) -> dict:
+        """Per-stage timing of one traced request: queue wait, execute
+        time, and the bucket its batch dispatched at (None for stages
+        that never ran — e.g. a shed request has no execute stage).
+        Served O(1) from the completed-request cache; falls back to a
+        span-ring scan for ids that aged out of it."""
+        with self._breakdown_lock:
+            hit = self._breakdowns.get(trace_id)
+            if hit is not None:
+                return dict(hit)
+        out = {"trace_id": trace_id, "queue_wait_ms": None,
+               "execute_ms": None, "total_ms": None, "status": None,
+               "batch_rows": None, "bucket": None}
+        for s in get_tracer().spans_for_trace(trace_id):
+            if s.name == "serving_queue_wait":
+                out["queue_wait_ms"] = s.duration_ms
+            elif s.name == "serving_execute":
+                out["execute_ms"] = s.duration_ms
+                rows = s.attrs.get("batch_rows")
+                out["batch_rows"] = rows
+                if rows:
+                    out["bucket"] = self.policy.bucket_rows(
+                        min(int(rows), self.policy.max_batch))
+            elif s.name == "serving_request":
+                out["total_ms"] = s.duration_ms
+                out["status"] = s.attrs.get("status")
+        return out
 
     # ----------------------------------------------------------- model admin
     def deploy(self, name: str, model_or_path, *, example=None,

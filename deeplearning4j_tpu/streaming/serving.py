@@ -24,11 +24,13 @@ from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.observability.health import (
     HealthEvaluator, HealthRule, default_serving_rules,
 )
+from deeplearning4j_tpu.observability.tracing import new_trace_id
 from deeplearning4j_tpu.serving import (
     ServingEngine, ServingError, ShuttingDownError,
 )
 
 logger = logging.getLogger("deeplearning4j_tpu.streaming")
+access_logger = logging.getLogger("deeplearning4j_tpu.serving.access")
 from deeplearning4j_tpu.streaming.pubsub import MessageBroker
 from deeplearning4j_tpu.streaming.serde import (
     array_to_base64, base64_to_array, record_to_dataset,
@@ -58,6 +60,16 @@ class InferenceServer:
       loads a ``models/serialization.py`` zip, warms every bucket shape,
       and atomically swaps it in with zero dropped requests.
 
+    Request tracing: every ``/predict`` request gets a ``trace_id`` —
+    taken from an ``X-Request-Id`` header when the client sent one,
+    minted otherwise — that is propagated through the engine (queue and
+    execute spans, shed errors, latency exemplars) and echoed in EVERY
+    JSON response body, success or error (429/503/504 included), so a
+    client-side timeout can be joined against the server-side spans.
+    With ``access_log=True`` one structured JSON line per completed
+    request (trace_id, status, bucket, queue_wait_ms, execute_ms) is
+    emitted on the ``deeplearning4j_tpu.serving.access`` logger.
+
     Constructor keeps the PR-1 signature; ``engine=`` supplies a custom
     (possibly shared, multi-model) engine instead.
     """
@@ -67,7 +79,7 @@ class InferenceServer:
                  max_queue: int = 256, deadline_s: float = 30.0,
                  example: Optional[np.ndarray] = None,
                  engine: Optional[ServingEngine] = None,
-                 health_rules=None):
+                 health_rules=None, access_log: bool = False):
         if engine is None:
             if model is None:
                 raise ValueError("InferenceServer needs a model or an engine")
@@ -104,16 +116,40 @@ class InferenceServer:
                             "micro-batch dispatcher thread liveness")))
         self.health = HealthEvaluator(rules, component="serving",
                                       registry=self.registry)
+        self.access_log = bool(access_log)
         self._requested_port = port
         self._httpd: Optional[ThreadingHTTPServer] = None
 
     def predict(self, features: np.ndarray, model: Optional[str] = None,
-                deadline_s: Optional[float] = None) -> np.ndarray:
+                deadline_s: Optional[float] = None,
+                trace_id: Optional[str] = None) -> np.ndarray:
         """Thread-safe enqueue + bounded wait (usable in-process without
         HTTP).  Raises typed ``ServingError`` subclasses on shed/timeout
         instead of ever hanging the caller."""
         return self.engine.predict(features, model=model,
-                                   deadline_s=deadline_s)
+                                   deadline_s=deadline_s, trace_id=trace_id)
+
+    def _access_line(self, trace_id: str, status: str, http_status: int,
+                     model: Optional[str]) -> None:
+        """One structured JSON log line per completed /predict request
+        (behind ``access_log=``): trace id, outcome, and the per-stage
+        breakdown read back from the span tracer."""
+        if not self.access_log:
+            return
+        try:
+            br = self.engine.request_breakdown(trace_id)
+            access_logger.info(json.dumps({
+                "trace_id": trace_id,
+                "model": model or self.engine.default_model,
+                "status": status,
+                "http_status": http_status,
+                "bucket": br["bucket"],
+                "queue_wait_ms": br["queue_wait_ms"],
+                "execute_ms": br["execute_ms"],
+                "total_ms": br["total_ms"],
+            }))
+        except Exception:   # an access-log failure must never 500 a reply
+            logger.debug("access-log line failed", exc_info=True)
 
     # ------------------------------------------------------------- lifecycle
     def start(self, warmup: bool = True) -> int:
@@ -174,6 +210,7 @@ class InferenceServer:
                     self.send_error(404)
 
             def do_POST(self):
+                self._trace_id = None
                 try:
                     if self.path == "/predict":
                         self._predict()
@@ -182,16 +219,32 @@ class InferenceServer:
                     else:
                         self.send_error(404)
                 except _BadRequest as e:
-                    self._json({"error": str(e)}, code=400)
+                    self._error_json(str(e), type(e).__name__, 400)
                 except ServingError as e:
-                    self._json({"error": str(e),
-                                "type": type(e).__name__},
-                               code=e.http_status)
+                    self._error_json(str(e), type(e).__name__,
+                                     e.http_status,
+                                     trace_id=getattr(e, "trace_id", None))
                 except Exception as e:  # never drop the socket without a
-                    self._json({"error": str(e),  # structured response
-                                "type": type(e).__name__}, code=500)
+                    self._error_json(str(e),  # structured response
+                                     type(e).__name__, 500)
+
+            def _error_json(self, msg, etype, code, trace_id=None):
+                tid = trace_id or self._trace_id
+                body = {"error": msg, "type": etype}
+                if tid is not None:
+                    body["trace_id"] = tid
+                    # log BEFORE the response flushes: the client must
+                    # never observe a completed request whose access-log
+                    # line has not been emitted yet
+                    server._access_line(tid, etype, code, None)
+                self._json(body, code=code)
 
             def _predict(self):
+                # trace id from the client when it sent one, minted at
+                # the HTTP edge otherwise — the same id rides the engine
+                # stages and comes back in the response body
+                tid = self.headers.get("X-Request-Id") or new_trace_id()
+                self._trace_id = tid
                 obj = self._read_json()
                 try:
                     if isinstance(obj, dict) and "data" in obj:
@@ -201,13 +254,16 @@ class InferenceServer:
                 except (ValueError, KeyError, TypeError) as e:
                     raise _BadRequest(f"bad request envelope: {e}")
                 try:
-                    out = server.predict(feats)
+                    out = server.predict(feats, trace_id=tid)
                 except ServingError:
                     raise
                 except Exception as e:  # model errors surface as 400s
-                    self._json({"error": str(e)}, code=400)
+                    server._access_line(tid, type(e).__name__, 400, None)
+                    self._json({"error": str(e), "trace_id": tid}, code=400)
                     return
-                self._json(array_to_base64(out))
+                # log BEFORE the response flushes (see _error_json)
+                server._access_line(tid, "ok", 200, None)
+                self._json({**array_to_base64(out), "trace_id": tid})
 
             def _swap(self, name):
                 obj = self._read_json()

@@ -1,0 +1,165 @@
+#!/usr/bin/env python
+"""CI gate: fail when a fresh ``bench_full.json`` regresses the committed
+baseline.
+
+Usage::
+
+    python scripts/check_bench_regression.py                 # committed vs itself (sanity)
+    python scripts/check_bench_regression.py --fresh /tmp/bench_full.json
+    python scripts/check_bench_regression.py --rules rules.json --json
+    python scripts/check_bench_regression.py --self-test     # rule-engine unit checks
+
+Exit codes: 0 clean, 1 regression (or self-test failure), 2 usage/IO
+error.  Rules come from ``observability/regression.py`` (DEFAULT_RULES,
+or a JSON list via ``--rules``).  The regression module is loaded by FILE
+PATH so this script never imports the package (and thus never imports
+jax) — it runs in milliseconds, same pattern as ``check_metrics_docs.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REGRESSION_PY = os.path.join(REPO, "deeplearning4j_tpu", "observability",
+                             "regression.py")
+
+
+def _load_regression():
+    spec = importlib.util.spec_from_file_location("_bench_regression",
+                                                  REGRESSION_PY)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _self_test(reg) -> int:
+    """Unit checks for the rule engine: both directions, the tolerance
+    boundary, missing-value handling, and rule (de)serialization — so the
+    sentinel's parsing can't rot unnoticed."""
+    checks = 0
+
+    def expect(cond, what):
+        nonlocal checks
+        checks += 1
+        if not cond:
+            print(f"self-test FAILED: {what}", file=sys.stderr)
+            sys.exit(1)
+
+    def doc(**entries):
+        return {"all": [{"metric": m, **(v if isinstance(v, dict)
+                                         else {"value": v})}
+                        for m, v in entries.items()]}
+
+    R = reg.Rule
+    base = doc(**{"Throughput (cfg)": 100.0, "Latency (cfg)": 10.0})
+
+    # higher-is-better: a 50% drop past a 20% tolerance regresses
+    rep = reg.compare(base, doc(**{"Throughput (cfg)": 50.0}),
+                      [R("Throughput", tolerance=0.2)])
+    expect(rep.exit_code == 1 and len(rep.regressions) == 1,
+           "50% throughput drop not flagged")
+    # within tolerance: ok
+    rep = reg.compare(base, doc(**{"Throughput (cfg)": 85.0}),
+                      [R("Throughput", tolerance=0.2)])
+    expect(rep.exit_code == 0, "15% drop inside 20% tolerance flagged")
+    # exactly at the limit: NOT a regression (strict inequality)
+    rep = reg.compare(base, doc(**{"Throughput (cfg)": 80.0}),
+                      [R("Throughput", tolerance=0.2)])
+    expect(rep.exit_code == 0, "boundary value flagged")
+    # improvement recognised
+    rep = reg.compare(base, doc(**{"Throughput (cfg)": 150.0}),
+                      [R("Throughput", tolerance=0.2)])
+    expect(rep.verdicts[0].status == "improved", "improvement not labeled")
+    # lower-is-better: latency doubling past tolerance regresses
+    rep = reg.compare(base, doc(**{"Latency (cfg)": 20.0}),
+                      [R("Latency", direction=reg.LOWER, tolerance=0.5)])
+    expect(rep.exit_code == 1, "latency doubling not flagged")
+    rep = reg.compare(base, doc(**{"Latency (cfg)": 12.0}),
+                      [R("Latency", direction=reg.LOWER, tolerance=0.5)])
+    expect(rep.exit_code == 0, "latency inside tolerance flagged")
+    # zero baseline + zero tolerance: any increase regresses (the
+    # steady-state-compiles contract)
+    zb = doc(**{"Serving (cfg)": {"value": 1.0, "steady_state_compiles": 0}})
+    zf = doc(**{"Serving (cfg)": {"value": 1.0, "steady_state_compiles": 2}})
+    rep = reg.compare(zb, zf, [R("Serving", field="steady_state_compiles",
+                                 direction=reg.LOWER, tolerance=0.0)])
+    expect(rep.exit_code == 1, "compile appearing over a 0 baseline passed")
+    # missing required value regresses; optional is only a warning
+    rep = reg.compare(base, {"all": []}, [R("Throughput")])
+    expect(rep.exit_code == 1, "missing required metric passed")
+    rep = reg.compare(base, {"all": []}, [R("Throughput", required=False)])
+    expect(rep.exit_code == 0
+           and rep.verdicts[0].status == "missing", "optional missing failed")
+    # missing baseline skips
+    rep = reg.compare({"all": []}, base, [R("Throughput")])
+    expect(rep.verdicts[0].status == "no_baseline", "no-baseline not skipped")
+    # dotted-field extraction
+    vb = doc(**{"Decode (cfg)": {"value": 1.0,
+                                 "variants": {"fast": {"tps": 100.0}}}})
+    vf = doc(**{"Decode (cfg)": {"value": 1.0,
+                                 "variants": {"fast": {"tps": 10.0}}}})
+    rep = reg.compare(vb, vf, [R("Decode", field="variants.fast.tps",
+                                 tolerance=0.2)])
+    expect(rep.exit_code == 1, "dotted-field regression not flagged")
+    # rule JSON round-trip + validation errors
+    r = R("Throughput", field="p99_ms", direction=reg.LOWER, tolerance=0.3,
+          required=False)
+    r2 = R.from_dict(r.to_dict())
+    expect(r2.to_dict() == r.to_dict(), "rule round-trip changed the rule")
+    for bad in ({"field": "value"}, {"metric": "x", "direction": "sideways"},
+                {"metric": "x", "bogus": 1}):
+        try:
+            R.from_dict(bad)
+        except ValueError:
+            checks += 1
+        else:
+            expect(False, f"bad rule accepted: {bad}")
+    # DEFAULT_RULES parse and self-compare clean against the committed file
+    committed = os.path.join(REPO, "bench_full.json")
+    if os.path.exists(committed):
+        rep = reg.check_files(committed, committed)
+        expect(rep.exit_code == 0,
+               "committed bench_full.json regresses against itself")
+    print(f"self-test: {checks} checks ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--baseline",
+                    default=os.path.join(REPO, "bench_full.json"),
+                    help="baseline bench_full.json (default: committed)")
+    ap.add_argument("--fresh", default=None,
+                    help="fresh bench_full.json to check "
+                         "(default: the baseline itself — a sanity pass)")
+    ap.add_argument("--rules", default=None,
+                    help="JSON list of rule dicts (default: DEFAULT_RULES)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the rule-engine unit checks and exit")
+    args = ap.parse_args(argv)
+    reg = _load_regression()
+    if args.self_test:
+        return _self_test(reg)
+    fresh = args.fresh or args.baseline
+    try:
+        rules = reg.load_rules(args.rules) if args.rules else None
+        report = reg.check_files(args.baseline, fresh, rules)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.format())
+    return report.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

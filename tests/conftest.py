@@ -54,6 +54,11 @@ def pytest_configure(config):
         "markers",
         "elastic: degraded-mode data parallelism and topology-portable "
         "resharded-resume tests (python -m pytest -m elastic)")
+    config.addinivalue_line(
+        "markers",
+        "profiling: performance-attribution tests — step profiler "
+        "captures, XLA cost analysis / MFU gauges, request tracing, bench "
+        "regression sentinel (python -m pytest -m profiling)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,135 @@
+"""Bench regression sentinel: the rule engine (direction, tolerance,
+missing-value handling), the CLI exit codes — nonzero on a synthetically
+regressed bench_full.json, zero on the committed one — and the --self-test
+wired into tier-1 so rule parsing can't rot."""
+
+import copy
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.profiling
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "check_bench_regression.py")
+COMMITTED = os.path.join(REPO, "bench_full.json")
+
+
+def _load_module():
+    spec = importlib.util.spec_from_file_location(
+        "_reg_under_test",
+        os.path.join(REPO, "deeplearning4j_tpu", "observability",
+                     "regression.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+reg = _load_module()
+
+
+def run_script(*args):
+    return subprocess.run([sys.executable, SCRIPT, *args],
+                          capture_output=True, text=True, timeout=60)
+
+
+# ----------------------------------------------------------- rule engine
+
+def test_direction_and_tolerance():
+    base = {"all": [{"metric": "Tput (x)", "value": 100.0},
+                    {"metric": "Lat (x)", "value": 10.0}]}
+    worse = {"all": [{"metric": "Tput (x)", "value": 70.0},
+                     {"metric": "Lat (x)", "value": 13.0}]}
+    rules = [reg.Rule("Tput", tolerance=0.2),
+             reg.Rule("Lat", direction=reg.LOWER, tolerance=0.2)]
+    rep = reg.compare(base, worse, rules)
+    assert [v.status for v in rep.verdicts] == ["regressed", "regressed"]
+    assert rep.exit_code == 1
+    within = {"all": [{"metric": "Tput (x)", "value": 85.0},
+                      {"metric": "Lat (x)", "value": 11.0}]}
+    assert reg.compare(base, within, rules).exit_code == 0
+
+
+def test_missing_and_no_baseline():
+    base = {"all": [{"metric": "Tput (x)", "value": 100.0}]}
+    rep = reg.compare(base, {"all": []}, [reg.Rule("Tput")])
+    assert rep.verdicts[0].status == "regressed"   # required by default
+    rep = reg.compare(base, {"all": []},
+                      [reg.Rule("Tput", required=False)])
+    assert rep.verdicts[0].status == "missing" and rep.exit_code == 0
+    rep = reg.compare({"all": []}, base, [reg.Rule("Tput")])
+    assert rep.verdicts[0].status == "no_baseline" and rep.exit_code == 0
+
+
+def test_dotted_field_and_rule_roundtrip():
+    base = {"all": [{"metric": "D (x)", "value": 1.0,
+                     "variants": {"v": {"tps": 50.0}}}]}
+    fresh = copy.deepcopy(base)
+    fresh["all"][0]["variants"]["v"]["tps"] = 10.0
+    rule = reg.Rule("D", field="variants.v.tps", tolerance=0.3)
+    assert reg.compare(base, fresh, [rule]).exit_code == 1
+    assert reg.Rule.from_dict(rule.to_dict()).to_dict() == rule.to_dict()
+    with pytest.raises(ValueError):
+        reg.Rule("x", direction="sideways")
+    with pytest.raises(ValueError):
+        reg.Rule.from_dict({"metric": "x", "bogus": 1})
+
+
+def test_default_rules_cover_committed_bench():
+    """Every required default rule finds its value in the committed
+    bench_full.json — a renamed metric would silently disarm the gate."""
+    with open(COMMITTED) as f:
+        doc = json.load(f)
+    for rule in reg.DEFAULT_RULES:
+        if rule.required:
+            assert reg.extract(doc, rule) is not None, rule.key
+
+
+# ------------------------------------------------------------ CLI contract
+
+def test_script_self_test_is_green():
+    out = run_script("--self-test")
+    assert out.returncode == 0, out.stderr
+    assert "self-test" in out.stdout
+
+
+def test_script_zero_on_committed_baseline():
+    out = run_script()
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "PASS" in out.stdout
+
+
+def test_script_nonzero_on_synthetic_regression(tmp_path):
+    """Acceptance: degrade the decode headline 60% in a copy of the
+    committed bench_full.json -> exit 1, naming the regressed rule."""
+    with open(COMMITTED) as f:
+        doc = json.load(f)
+    for entry in doc["all"]:
+        if entry["metric"].startswith("Decode tokens/sec"):
+            entry["value"] = entry["value"] * 0.4
+    fresh = tmp_path / "bench_full.json"
+    fresh.write_text(json.dumps(doc))
+    out = run_script("--fresh", str(fresh))
+    assert out.returncode == 1
+    assert "REGRESSED" in out.stdout
+    assert "Decode tokens/sec" in out.stdout
+    # --json variant carries the structured report
+    out = run_script("--fresh", str(fresh), "--json")
+    assert out.returncode == 1
+    report = json.loads(out.stdout)
+    assert report["regressed"] >= 1
+
+
+def test_script_custom_rules_and_bad_input(tmp_path):
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps(
+        [{"metric": "Serving rows/sec", "tolerance": 0.4}]))
+    out = run_script("--rules", str(rules))
+    assert out.returncode == 0
+    assert "1 checked rule" in out.stdout.replace("rule(s)", "rule")
+    out = run_script("--fresh", str(tmp_path / "nope.json"))
+    assert out.returncode == 2

@@ -1,0 +1,237 @@
+"""End-to-end request tracing: trace ids minted at the HTTP edge (or
+accepted from X-Request-Id), propagated request -> admission -> batcher
+queue -> dispatch -> execute -> response; per-stage spans retrievable from
+SpanTracer by trace id; shed/deadline errors naming the id; latency
+exemplars; and the access log."""
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.dense import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observability import (
+    FlightRecorder, MetricsRegistry, SpanTracer, get_registry, get_tracer,
+    new_trace_id, set_flight_recorder, set_registry, set_tracer,
+)
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder,
+)
+from deeplearning4j_tpu.serving import ServingEngine
+from deeplearning4j_tpu.serving.admission import (
+    DeadlineExceededError, QueueFullError, ServingError, ShuttingDownError,
+)
+from deeplearning4j_tpu.streaming.serving import InferenceServer
+
+pytestmark = pytest.mark.profiling
+
+N_IN, N_OUT = 8, 4
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    old_reg = get_registry()
+    old_tr = get_tracer()
+    reg = set_registry(MetricsRegistry())
+    set_tracer(SpanTracer(max_spans=65536))
+    set_flight_recorder(FlightRecorder())
+    yield reg
+    set_registry(old_reg)
+    set_tracer(old_tr)
+    set_flight_recorder(FlightRecorder())
+
+
+def make_net(seed=7):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder().seed(seed)
+         .updater("sgd", learning_rate=0.1).list()
+         .layer(DenseLayer(n_in=N_IN, n_out=16))
+         .layer(OutputLayer(n_in=16, n_out=N_OUT)).build())).init()
+
+
+def test_new_trace_id_shape_and_uniqueness():
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+    assert all(len(t) == 16 and int(t, 16) >= 0 for t in ids)
+
+
+def test_concurrent_mixed_bucket_trace_propagation():
+    """Acceptance: concurrent mixed-bucket load — every response is the
+    model's output for ITS OWN request (no cross-batch swaps), and the
+    queue/execute span breakdown is retrievable from SpanTracer by each
+    request's trace id."""
+    net = make_net()
+    engine = ServingEngine(net, max_batch=8, max_wait_ms=1.0,
+                           max_queue=4096,
+                           example=np.zeros((N_IN,), np.float32))
+    engine.start()
+    results = {}
+    errors = []
+    lock = threading.Lock()
+
+    def client(tid_idx):
+        rs = np.random.RandomState(100 + tid_idx)
+        try:
+            for j in range(6):
+                rows = 1 + int(rs.randint(6))      # mixed bucket sizes
+                x = rs.rand(rows, N_IN).astype(np.float32)
+                trace_id = f"client{tid_idx:02d}-req{j:02d}----"
+                out = engine.predict(x, trace_id=trace_id)
+                with lock:
+                    results[trace_id] = (x, np.asarray(out))
+        except Exception as e:
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    engine.stop()
+    assert not errors, errors
+    assert len(results) == 36
+    tracer = get_tracer()
+    for trace_id, (x, out) in results.items():
+        # the response really belongs to this request's input
+        expected = np.asarray(net.output(x))
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+        # per-stage breakdown by this id
+        names = {s.name for s in tracer.spans_for_trace(trace_id)}
+        assert {"serving_request", "serving_queue_wait",
+                "serving_execute"} <= names
+        br = engine.request_breakdown(trace_id)
+        assert br["status"] == "ok"
+        assert br["queue_wait_ms"] >= 0.0
+        assert br["execute_ms"] > 0.0
+        assert br["bucket"] >= br["batch_rows"] or br["batch_rows"] > 8
+
+
+def test_shed_and_deadline_errors_name_the_trace_id():
+    """Acceptance: a shed request's error names the same trace id the
+    caller submitted (attribute, message, and flight event)."""
+    net = make_net()
+    engine = ServingEngine(net, max_batch=4, max_queue=2, deadline_s=0.3,
+                           example=np.zeros((N_IN,), np.float32))
+    # dispatcher NOT started: the queue can only fill or expire
+    x = np.random.rand(1, N_IN).astype(np.float32)
+    caught = {}
+
+    def call(tid):
+        try:
+            engine.predict(x, trace_id=tid)
+        except ServingError as e:
+            caught[tid] = e
+
+    threads = [threading.Thread(target=call, args=(f"trace-{i:04d}-ab",))
+               for i in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert len(caught) == 4
+    kinds = {type(e) for e in caught.values()}
+    assert QueueFullError in kinds          # queue budget is 2
+    assert DeadlineExceededError in kinds   # nobody drained the queue
+    for tid, e in caught.items():
+        assert e.trace_id == tid
+        assert tid in str(e)
+    sheds = [e.to_dict() for e in get_flight_recorder().events()
+             if e.kind == "shed"]
+    shed_ids = {s.get("trace_id") for s in sheds}
+    assert set(caught) <= shed_ids
+
+
+def test_latency_exemplar_carries_trace_id():
+    net = make_net()
+    engine = ServingEngine(net, max_batch=8,
+                           example=np.zeros((N_IN,), np.float32))
+    engine.start()
+    tid = new_trace_id()
+    engine.predict(np.random.rand(2, N_IN).astype(np.float32), trace_id=tid)
+    engine.stop()
+    exemplars = engine.metrics.latency.get().exemplars()
+    assert any(e["trace_id"] == tid for e in exemplars.values())
+
+
+def test_http_trace_id_echo_and_access_log(caplog):
+    """HTTP edge: X-Request-Id is echoed in the response body, a minted id
+    appears when the client sends none, and access_log=True emits one
+    structured line per completed request."""
+    srv = InferenceServer(make_net(), max_batch=8,
+                          example=np.zeros((N_IN,), np.float32),
+                          access_log=True)
+    port = srv.start()
+    try:
+        with caplog.at_level(logging.INFO,
+                             logger="deeplearning4j_tpu.serving.access"):
+            body = json.dumps(np.random.rand(2, N_IN).tolist()).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"X-Request-Id": "edge-trace-000001"})
+            resp = json.load(urllib.request.urlopen(req))
+            assert resp["trace_id"] == "edge-trace-000001"
+            # no header -> server mints one
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body)
+            resp2 = json.load(urllib.request.urlopen(req2))
+            assert len(resp2["trace_id"]) == 16
+        lines = [json.loads(r.message) for r in caplog.records
+                 if r.name == "deeplearning4j_tpu.serving.access"]
+        assert len(lines) == 2
+        by_id = {l["trace_id"]: l for l in lines}
+        line = by_id["edge-trace-000001"]
+        assert line["status"] == "ok" and line["http_status"] == 200
+        assert line["queue_wait_ms"] >= 0.0
+        assert line["execute_ms"] > 0.0
+        assert line["bucket"] in (2, 4, 8)
+    finally:
+        srv.stop()
+
+
+def test_http_error_payload_names_trace_id(caplog):
+    """429/503/504-class errors carry the trace id in the JSON payload
+    and still produce an access-log line."""
+    srv = InferenceServer(make_net(), max_batch=8,
+                          example=np.zeros((N_IN,), np.float32),
+                          access_log=True)
+    port = srv.start()
+    try:
+        srv.engine.stop(drain=False)   # -> ShuttingDownError (503)
+        with caplog.at_level(logging.INFO,
+                             logger="deeplearning4j_tpu.serving.access"):
+            body = json.dumps(np.random.rand(1, N_IN).tolist()).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"X-Request-Id": "edge-trace-err-01"})
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req)
+            err = exc_info.value
+            assert err.code == 503
+            payload = json.load(err)
+            assert payload["trace_id"] == "edge-trace-err-01"
+            assert payload["type"] == "ShuttingDownError"
+        lines = [json.loads(r.message) for r in caplog.records
+                 if r.name == "deeplearning4j_tpu.serving.access"]
+        assert any(l["trace_id"] == "edge-trace-err-01"
+                   and l["http_status"] == 503 for l in lines)
+    finally:
+        srv.stop()
+
+
+def test_access_log_off_by_default(caplog):
+    srv = InferenceServer(make_net(), max_batch=8,
+                          example=np.zeros((N_IN,), np.float32))
+    port = srv.start()
+    try:
+        with caplog.at_level(logging.INFO,
+                             logger="deeplearning4j_tpu.serving.access"):
+            body = json.dumps(np.random.rand(1, N_IN).tolist()).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body))
+        assert not [r for r in caplog.records
+                    if r.name == "deeplearning4j_tpu.serving.access"]
+    finally:
+        srv.stop()
