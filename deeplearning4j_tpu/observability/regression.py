@@ -92,6 +92,14 @@ DEFAULT_RULES: List[Rule] = [
     Rule("Elastic DP samples/sec", tolerance=0.4),
     Rule("Elastic DP samples/sec", field="degraded_vs_lockstep_speedup",
          tolerance=0.5, required=False),
+    # stream-to-serving model freshness: seconds from a published event to
+    # a swapped-in model serving it, under concurrent load (bench_online).
+    # Smaller is better; tolerance is wide because the window includes
+    # eval + canary + watch phases whose sleeps jitter on a loaded CPU.
+    Rule("Online stream-to-serving freshness", direction=LOWER,
+         tolerance=1.0),
+    Rule("Online stream-to-serving freshness", field="promoted",
+         tolerance=0.0, required=False),
 ]
 
 

@@ -1,0 +1,25 @@
+"""Continuous online learning: stream -> windowed incremental fit ->
+checkpointed candidates -> SLO-gated promotion with canary, zero-drop
+hot-swap, post-swap watch, and automatic rollback.
+
+The production loop the rest of the stack provides the pieces for
+(streaming pub/sub, resilience, serving, health SLOs) — one pipeline
+that ingests live traffic, learns from it, and redeploys itself
+continuously, with every stage hardened against its real failure mode.
+See docs/online.md.
+"""
+
+from deeplearning4j_tpu.online.consumer import StreamConsumer
+from deeplearning4j_tpu.online.pipeline import OnlineLearningPipeline
+from deeplearning4j_tpu.online.promotion import (
+    CANARY_REJECTED, PROMOTED, REJECTED, ROLLBACK_FAILED, ROLLED_BACK,
+    PromotionManager, PromotionResult, default_gate_rules,
+    default_watch_rules,
+)
+
+__all__ = [
+    "CANARY_REJECTED", "PROMOTED", "REJECTED", "ROLLBACK_FAILED",
+    "ROLLED_BACK", "OnlineLearningPipeline", "PromotionManager",
+    "PromotionResult", "StreamConsumer", "default_gate_rules",
+    "default_watch_rules",
+]

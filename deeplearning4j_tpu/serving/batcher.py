@@ -73,6 +73,14 @@ class DynamicBatcher:
     def queued(self) -> int:
         return self._queued
 
+    def queued_for(self, model: str) -> int:
+        """Pending (not yet dispatched) requests targeting one model name
+        — the canary teardown waits on this before the name leaves the
+        registry, so no queued request can fail its lease."""
+        with self._cv:
+            return sum(len(dq) for key, dq in self._pending.items()
+                       if key[0] == model)
+
     def is_alive(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 import weakref
@@ -37,8 +38,8 @@ from deeplearning4j_tpu.observability.flightrecorder import (
 from deeplearning4j_tpu.observability.servingmetrics import ServingMetrics
 from deeplearning4j_tpu.observability.tracing import get_tracer, new_trace_id
 from deeplearning4j_tpu.serving.admission import (
-    AdmissionController, DeadlineExceededError, QueueFullError, Request,
-    ServingError, ShuttingDownError,
+    AdmissionController, DeadlineExceededError, ModelNotFoundError,
+    QueueFullError, Request, ServingError, ShuttingDownError,
 )
 from deeplearning4j_tpu.serving.batcher import DynamicBatcher
 from deeplearning4j_tpu.serving.buckets import BucketPolicy
@@ -52,6 +53,55 @@ from deeplearning4j_tpu.serving.warmup import (
 logger = logging.getLogger("deeplearning4j_tpu.serving")
 
 DEFAULT_MODEL = "default"
+
+
+class _CanaryRoute:
+    """Traffic split for one model name: requests for the primary are
+    rerouted to the canary version with probability ``fraction`` (seeded
+    RNG — tests and replays see the same routing sequence), and every
+    rerouted request's outcome is tallied.  The promotion watch decides
+    promote-vs-reject on these counts, so sheds are tracked separately:
+    a full queue is the engine's state, not the canary's fault, while
+    errors and deadline expiries on canary traffic are exactly the
+    regressions a canary exists to absorb before a full swap would."""
+
+    def __init__(self, canary_model: str, fraction: float, seed: int = 0):
+        self.canary_model = canary_model
+        self.fraction = float(fraction)
+        self.started = time.time()
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.counts = {"ok": 0, "error": 0, "deadline": 0, "shed": 0}
+
+    def take(self) -> bool:
+        if self.fraction >= 1.0:
+            return True
+        if self.fraction <= 0.0:
+            return False
+        with self._lock:
+            return self._rng.random() < self.fraction
+
+    def record(self, status: str) -> None:
+        if status not in self.counts:
+            status = "shed" if status in ("queue_full", "shutdown") else "error"
+        with self._lock:
+            self.counts[status] += 1
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            counts = dict(self.counts)
+        total = sum(counts.values())
+        # sheds are visible but JUDGE nothing: a full queue is the
+        # engine's load, not the canary's regression — the evidence
+        # threshold and the error rate are over requests that actually
+        # reached (or should have reached) the model
+        judged = counts["ok"] + counts["error"] + counts["deadline"]
+        bad = counts["error"] + counts["deadline"]
+        return {"canary_model": self.canary_model,
+                "fraction": self.fraction,
+                "requests": total, "judged": judged, "bad": bad,
+                "error_rate": (bad / judged) if judged else 0.0,
+                **counts}
 
 
 class ServingEngine:
@@ -94,6 +144,11 @@ class ServingEngine:
         self._breakdowns: "OrderedDict[str, dict]" = OrderedDict()
         self._breakdown_lock = threading.Lock()
         self._breakdown_cap = 2048
+        # name -> _CanaryRoute: a fraction of this model's traffic is
+        # diverted to a candidate version (see start_canary)
+        self._canary: "dict[str, _CanaryRoute]" = {}
+        # per-model outcome tallies (see status_counts)
+        self._model_status: "dict[str, dict[str, int]]" = {}
 
     def _bind_queue_gauge(self) -> None:
         # weakref: the registry outlives the engine — a strong closure
@@ -143,7 +198,12 @@ class ServingEngine:
         (``.trace_id`` attribute + message), shed flight events name it,
         and it is sampled as the exemplar onto the latency histogram."""
         trace_id = trace_id or new_trace_id()
-        model = model or self.default_model
+        primary = model = model or self.default_model
+        route = self._canary.get(model)
+        if route is not None and route.take():
+            model = route.canary_model
+        else:
+            route = None
         feats = np.asarray(features, np.float32)
         if feats.ndim == 1:
             feats = feats[None, :]
@@ -164,7 +224,20 @@ class ServingEngine:
         t0_ns = time.perf_counter_ns()
         status = "error"
         try:
-            res = self._predict_wait(req, model, deadline, trace_id, t0)
+            try:
+                res = self._predict_wait(req, model, deadline, trace_id, t0,
+                                         quiet_model_missing=route is not None)
+            except ModelNotFoundError:
+                if route is None:
+                    raise
+                # the canary was torn down between routing and dispatch
+                # (stop_canary's queue drain cannot see a request that
+                # passed take() but hasn't submitted yet) — the zero-drop
+                # contract outranks the split: fall back to the primary
+                model = primary
+                req = Request(feats, model, deadline, orig_seq,
+                              trace_id=trace_id)
+                res = self._predict_wait(req, model, deadline, trace_id, t0)
             status = "ok"
             if (orig_seq is not None and res.ndim >= 3
                     and res.shape[1] > orig_seq):
@@ -181,12 +254,19 @@ class ServingEngine:
                 status=status)
             self._remember_breakdown(req, trace_id, status,
                                      (t1_ns - t0_ns) / 1e6)
+            if route is not None:
+                route.record(status)
 
     def _predict_wait(self, req: Request, model: str, deadline: float,
-                      trace_id: str, t0: float) -> np.ndarray:
+                      trace_id: str, t0: float,
+                      quiet_model_missing: bool = False) -> np.ndarray:
         """Submit + bounded wait + result classification (the predict
         body; split so ``predict`` can bracket it with the request
-        span)."""
+        span).  ``quiet_model_missing`` (the canary-routed attempt): a
+        ``ModelNotFoundError`` result re-raises WITHOUT counters or
+        flight events — the caller retries on the primary, and one
+        client call must not show up as a phantom error next to its own
+        success in the metrics the SLO rules read."""
         try:
             self.batcher.submit(req)
         except ServingError as e:
@@ -213,10 +293,12 @@ class ServingEngine:
                     f"[trace {trace_id}]")
                 err.trace_id = trace_id
                 raise err
+        res = req.result[0]
+        if quiet_model_missing and isinstance(res, ModelNotFoundError):
+            raise res    # primary retry owns this request's accounting
         self.metrics.latency.observe(time.perf_counter() - t0,
                                      exemplar=trace_id)
         self.metrics.request_rows.observe(req.rows)
-        res = req.result[0]
         if isinstance(res, Exception):
             if isinstance(res, DeadlineExceededError):
                 self.metrics.requests.inc(status="deadline")
@@ -236,7 +318,11 @@ class ServingEngine:
                             total_ms: float) -> None:
         """Cache the completed request's per-stage timings (stamped on
         the Request by the batcher) under its trace id — O(1) for the
-        access log, immune to span-ring eviction."""
+        access log, immune to span-ring eviction.  Also tallies the
+        outcome under the request's MODEL name (``status_counts``): the
+        shared ``dl4j_serving_requests_total`` counter has no model
+        label, and the promotion watch must not attribute another
+        model's errors to a freshly swapped candidate."""
         entry = {
             "trace_id": trace_id,
             "queue_wait_ms": (None if req.queue_wait_ns is None
@@ -255,6 +341,15 @@ class ServingEngine:
             self._breakdowns.move_to_end(trace_id)
             while len(self._breakdowns) > self._breakdown_cap:
                 self._breakdowns.popitem(last=False)
+            tally = self._model_status.setdefault(req.model, {})
+            tally[status] = tally.get(status, 0) + 1
+
+    def status_counts(self, model: str) -> dict:
+        """Cumulative request outcomes for ONE model name (``ok`` /
+        ``error`` / ``deadline`` / ``queue_full`` / ``shutdown``) — the
+        per-model view the promotion watch diffs across its window."""
+        with self._breakdown_lock:
+            return dict(self._model_status.get(model, {}))
 
     def request_breakdown(self, trace_id: str) -> dict:
         """Per-stage timing of one traced request: queue wait, execute
@@ -287,13 +382,22 @@ class ServingEngine:
     # ----------------------------------------------------------- model admin
     def deploy(self, name: str, model_or_path, *, example=None,
                version: Optional[int] = None, warmup: bool = True,
+               retain_old: bool = False,
                drain_timeout: float = 30.0) -> ModelVersion:
         """Register a model (or load a checkpoint path via
         ``models/serialization.py``) as the next version of ``name`` and
         hot-swap it in: the incoming version is warmed across all bucket
         shapes BEFORE the atomic flip, in-flight batches finish on the
         old version under their leases, then the old version retires.
-        No request is dropped at any point."""
+        No request is dropped at any point.
+
+        With ``retain_old`` the displaced version is NOT retired: it
+        stays loaded in state ``retained`` as the ``rollback`` target —
+        the promotion watch window's undo button.  Close the window with
+        ``commit_swap`` (keep the new version, retire the old) or
+        ``rollback`` (flip back, retire the new).  A still-unresolved
+        retained version from an earlier retaining swap is committed
+        first — at most one rollback target exists per name."""
         with self._swap_lock:   # serialize swaps per engine
             if isinstance(model_or_path, (str, bytes, os.PathLike)):
                 mv = load_version_from_checkpoint(
@@ -309,19 +413,158 @@ class ServingEngine:
                     warmup_version(mv, self.policy, metrics=self.metrics)
                 except NoWarmupShapeError as e:
                     logger.warning("deploying %s unwarmed: %s", mv.key, e)
-            old = self.models.activate(mv)
+            # ANY swap supersedes a still-open rollback window: commit it
+            # (drain + release the retained weights) rather than letting
+            # activate() park the stale version in the history with its
+            # model pinned
+            self._commit_locked(name, drain_timeout)
+            old = self.models.activate(mv, retain=retain_old)
             get_flight_recorder().record(
                 "swap", model=name, version=mv.version,
-                replaced=old.version if old else None)
+                replaced=old.version if old else None,
+                retained=bool(retain_old and old is not None))
             if old is not None:
                 self.metrics.swaps.inc(model=name)
-                if not self.models.retire(old, timeout=drain_timeout):
+                if not retain_old and not self.models.retire(
+                        old, timeout=drain_timeout):
                     logger.warning(
                         "old version %s still has in-flight batches after "
                         "%.1fs; left un-retired", old.key, drain_timeout)
-            logger.info("%s now serving (replaced %s)", mv.key,
-                        old.key if old else "nothing")
+            logger.info("%s now serving (replaced %s%s)", mv.key,
+                        old.key if old else "nothing",
+                        ", retained for rollback"
+                        if retain_old and old else "")
             return mv
+
+    def rollback(self, name: str, *,
+                 drain_timeout: float = 30.0) -> ModelVersion:
+        """Undo the last retaining swap of ``name``: atomically flip the
+        active pointer back to the retained previous version, then retire
+        the displaced (regressed) version after its in-flight batches
+        drain.  Zero requests are dropped: a request leasing during the
+        flip completes on whichever version its batch pinned.  Raises
+        ``ModelNotFoundError`` when no rollback window is open."""
+        with self._swap_lock:
+            restored, displaced = self.models.rollback(name)
+            get_flight_recorder().record(
+                "rollback", model=name, restored=restored.version,
+                displaced=displaced.version if displaced else None)
+            self.metrics.swaps.inc(model=name)
+            logger.warning(
+                "%s ROLLED BACK to %s (displacing %s)", name, restored.key,
+                displaced.key if displaced else "nothing")
+            if displaced is not None and not self.models.retire(
+                    displaced, timeout=drain_timeout):
+                logger.warning(
+                    "rolled-back version %s still has in-flight batches "
+                    "after %.1fs; left un-retired", displaced.key,
+                    drain_timeout)
+            return restored
+
+    def commit_swap(self, name: str, *,
+                    drain_timeout: float = 30.0) -> Optional[ModelVersion]:
+        """Close the rollback window after a ``deploy(...,
+        retain_old=True)`` that watched clean: retire the retained
+        previous version (drain, release weights).  Returns it, or None
+        when no window was open — committing twice is harmless."""
+        with self._swap_lock:
+            return self._commit_locked(name, drain_timeout)
+
+    def _commit_locked(self, name: str,
+                       drain_timeout: float) -> Optional[ModelVersion]:
+        mv = self.models.release_retained(name)
+        if mv is not None and not self.models.retire(
+                mv, timeout=drain_timeout):
+            logger.warning(
+                "retained version %s still has in-flight batches after "
+                "%.1fs; left un-retired", mv.key, drain_timeout)
+        return mv
+
+    # ---------------------------------------------------------------- canary
+    def start_canary(self, name: str, model_or_path, *,
+                     fraction: float = 0.1, example=None,
+                     seed: int = 0) -> ModelVersion:
+        """Serve a candidate next to ``name`` on a traffic fraction: the
+        candidate is warmed and registered under ``<name>:canary``, and
+        each later ``predict(model=name)`` is rerouted to it with
+        probability ``fraction`` (seeded).  Outcomes of rerouted requests
+        are tallied (``canary_stats``); ``stop_canary`` tears the split
+        down again.  The primary version is untouched throughout — a
+        canary that fails its warmup never serves a single request."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], got {fraction}")
+        with self._swap_lock:
+            if name in self._canary:
+                raise ValueError(f"{name!r} already has a live canary")
+            self.models.active(name)   # primary must exist (raises if not)
+            canary_name = f"{name}:canary"
+            if isinstance(model_or_path, (str, bytes, os.PathLike)):
+                mv = load_version_from_checkpoint(
+                    self.models, canary_name, model_or_path, example=example)
+            else:
+                mv = self.models.new_version(
+                    canary_name, model_or_path, example=example)
+            try:
+                warmup_version(mv, self.policy, metrics=self.metrics)
+            except NoWarmupShapeError as e:
+                logger.warning("canary %s unwarmed: %s", mv.key, e)
+            self.models.activate(mv)
+            self._canary[name] = _CanaryRoute(canary_name, fraction,
+                                              seed=seed)
+            get_flight_recorder().record(
+                "canary_start", model=name, version=mv.version,
+                fraction=fraction)
+            logger.info("canary %s serving %.0f%% of %r traffic", mv.key,
+                        100.0 * fraction, name)
+            return mv
+
+    def canary_stats(self, name: str) -> Optional[dict]:
+        route = self._canary.get(name)
+        return route.as_dict() if route is not None else None
+
+    def stop_canary(self, name: str, *,
+                    drain_timeout: float = 30.0) -> Optional[dict]:
+        """Tear down ``name``'s traffic split: stop routing new requests
+        to the canary, wait (bounded) until every request already queued
+        for the canary name has dispatched — a queued request must never
+        fail its lease against a removed registry entry — then retire the
+        canary version.  Returns the final outcome tally, or None when no
+        canary was live.  The queue wait happens OUTSIDE the swap lock so
+        deploys/rollbacks are never blocked behind a canary backlog."""
+        with self._swap_lock:
+            route = self._canary.pop(name, None)
+            if route is None:
+                return None
+            stats = route.as_dict()
+            try:
+                mv = self.models.active(route.canary_model)
+            except ModelNotFoundError:
+                mv = None
+        deadline = time.monotonic() + drain_timeout
+        while (self.batcher.queued_for(route.canary_model) > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        with self._swap_lock:
+            if name not in self._canary:
+                # a NEW canary for this name may have started while we
+                # waited; the registry entry then belongs to it — only
+                # tear the name down while OUR version still owns it
+                try:
+                    if self.models.active(route.canary_model) is mv:
+                        self.models.remove(route.canary_model)
+                except ModelNotFoundError:
+                    pass
+            if mv is not None and not self.models.retire(
+                    mv, timeout=drain_timeout):
+                logger.warning(
+                    "canary %s still has in-flight batches after %.1fs; "
+                    "left un-retired", mv.key, drain_timeout)
+            get_flight_recorder().record(
+                "canary_stop", model=name,
+                version=mv.version if mv else None, **{
+                    k: stats[k] for k in
+                    ("requests", "judged", "bad", "error_rate")})
+            return stats
 
     def stats(self) -> dict:
         """Live engine state for the HTTP /models endpoint."""
@@ -334,6 +577,7 @@ class ServingEngine:
                             if self.policy.seq_buckets else None),
             "max_queue": self.admission.max_queue,
             "dispatcher_alive": self.batcher.is_alive(),
+            "canaries": {n: r.as_dict() for n, r in self._canary.items()},
         }
 
     # ------------------------------------------------------------- execution

@@ -15,13 +15,14 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.observability.recompile import RecompileDetector
 from deeplearning4j_tpu.serving.admission import ModelNotFoundError
 
 ACTIVE = "active"
 PENDING = "pending"    # loaded + warming, not yet serving
+RETAINED = "retained"  # displaced by a swap, kept loaded for rollback
 RETIRED = "retired"
 
 
@@ -69,6 +70,7 @@ class ModelRegistry:
     def __init__(self, metrics_registry=None):
         self._cv = threading.Condition()
         self._active: Dict[str, ModelVersion] = {}
+        self._previous: Dict[str, ModelVersion] = {}   # rollback targets
         self._history: List[ModelVersion] = []
         self._next_version: Dict[str, int] = {}
         self._metrics_registry = metrics_registry
@@ -88,19 +90,99 @@ class ModelRegistry:
             return ModelVersion(name, v, model, example,
                                 self._metrics_registry)
 
-    def activate(self, mv: ModelVersion) -> Optional[ModelVersion]:
+    def activate(self, mv: ModelVersion,
+                 retain: bool = False) -> Optional[ModelVersion]:
         """Atomically make ``mv`` the active version of its name;
         returns the displaced version (still counted in-flight by any
-        executing batches) or None."""
+        executing batches) or None.
+
+        With ``retain`` the displaced version is NOT moved to the retired
+        history: it keeps its model loaded in state ``retained`` and
+        becomes the ``rollback`` target — the post-swap watch window's
+        undo button.  Callers that retain must eventually resolve the
+        pair: ``rollback(name)`` to flip back, or ``release_retained``
+        (then ``retire``) once the watch window closes cleanly.  An
+        earlier retained version still unresolved when a new swap lands
+        is returned to the history (model intact — the caller retires it
+        to release the weights)."""
         with self._cv:
             old = self._active.get(mv.name)
+            stale_retained = self._previous.pop(mv.name, None)
             mv.state = ACTIVE
             self._active[mv.name] = mv
             if old is not None:
-                self._history.append(old)
-                del self._history[:-self.HISTORY_LIMIT]
+                if retain:
+                    old.state = RETAINED
+                    self._previous[mv.name] = old
+                else:
+                    self._history.append(old)
+            if stale_retained is not None and stale_retained is not old:
+                self._history.append(stale_retained)
+            del self._history[:-self.HISTORY_LIMIT]
             self._cv.notify_all()
             return old
+
+    def rollback(self, name: str) -> "Tuple[ModelVersion, ModelVersion]":
+        """Atomically flip the active pointer of ``name`` back to the
+        version retained by the last ``activate(..., retain=True)``.
+        Returns ``(restored, displaced)``: the restored previous version
+        (now active again) and the displaced bad version — still serving
+        its in-flight leased batches, so the caller ``retire``s it after
+        the flip to drain and release it.  Raises ``ModelNotFoundError``
+        when nothing is retained (rollback window already closed or no
+        retaining swap happened).
+
+        Like ``activate`` this is one atomic pointer flip under the
+        registry lock: a request leasing concurrently gets either the bad
+        version (its batch completes under the lease) or the restored
+        one — never an error, never a dropped request."""
+        with self._cv:
+            prev = self._previous.pop(name, None)
+            if prev is None:
+                raise ModelNotFoundError(
+                    f"no retained previous version of {name!r} to roll "
+                    f"back to")
+            displaced = self._active.get(name)
+            prev.state = ACTIVE
+            self._active[name] = prev
+            if displaced is not None:
+                self._history.append(displaced)
+                del self._history[:-self.HISTORY_LIMIT]
+            self._cv.notify_all()
+            return prev, displaced
+
+    def retained(self, name: str) -> Optional[ModelVersion]:
+        with self._cv:
+            return self._previous.get(name)
+
+    def release_retained(self, name: str) -> Optional[ModelVersion]:
+        """Close the rollback window: pop the retained previous version
+        (watch window passed cleanly) and move it to the history.  The
+        caller ``retire``s the returned version to drain its in-flight
+        batches and release the model reference; returns None when
+        nothing is retained."""
+        with self._cv:
+            mv = self._previous.pop(name, None)
+            if mv is not None:
+                self._history.append(mv)
+                del self._history[:-self.HISTORY_LIMIT]
+            return mv
+
+    def remove(self, name: str) -> Optional[ModelVersion]:
+        """Drop ``name`` from the active map entirely (canary teardown —
+        the route name stops existing rather than being replaced).
+        Returns the removed version, moved to the history with its model
+        intact; the caller ``retire``s it to drain in-flight batches and
+        release the weights.  None when the name was never registered."""
+        with self._cv:
+            mv = self._active.pop(name, None)
+            stale = self._previous.pop(name, None)
+            for m in (mv, stale):
+                if m is not None:
+                    self._history.append(m)
+            del self._history[:-self.HISTORY_LIMIT]
+            self._cv.notify_all()
+            return mv
 
     def register(self, name: str, model, example=None,
                  version: Optional[int] = None) -> ModelVersion:
@@ -146,6 +228,8 @@ class ModelRegistry:
             return {
                 "active": {n: mv.as_dict()
                            for n, mv in self._active.items()},
+                "retained": {n: mv.as_dict()
+                             for n, mv in self._previous.items()},
                 "retired": [mv.as_dict() for mv in self._history],
             }
 

@@ -1,6 +1,6 @@
 from deeplearning4j_tpu.streaming.serde import (
-    array_to_base64, base64_to_array, dataset_to_json, dataset_from_json,
-    record_to_dataset,
+    BadRecordError, array_to_base64, base64_to_array, consume_dataset_json,
+    dataset_to_json, dataset_from_json, record_to_dataset,
 )
 from deeplearning4j_tpu.streaming.pubsub import (
     MessageBroker, NDArrayPublisher, NDArrayConsumer,

@@ -13,8 +13,10 @@ transport so producers/consumers can sit in different processes
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -23,15 +25,22 @@ import numpy as np
 
 from deeplearning4j_tpu.streaming.serde import array_to_base64, base64_to_array
 
+_DROPPED = "dl4j_stream_dropped_total"
+_DROP_WARN_INTERVAL_S = 30.0
+
+logger = logging.getLogger("deeplearning4j_tpu.streaming")
+
 
 class MessageBroker:
     """In-process topic broker; each subscriber gets an independent bounded
     queue (Kafka consumer-group-of-one semantics)."""
 
-    def __init__(self, queue_size: int = 1024):
+    def __init__(self, queue_size: int = 1024, registry=None):
         self._queue_size = queue_size
         self._topics: Dict[str, List[queue.Queue]] = {}
         self._lock = threading.Lock()
+        self._registry = registry
+        self._last_drop_warn: Dict[str, float] = {}
 
     def subscribe(self, topic: str) -> "queue.Queue[str]":
         q: "queue.Queue[str]" = queue.Queue(maxsize=self._queue_size)
@@ -49,7 +58,11 @@ class MessageBroker:
         """Deliver to every subscriber.  A full subscriber queue drops its
         OLDEST message (bounded-lag semantics, like a Kafka consumer falling
         behind a retention window) — publish never blocks on a slow or
-        abandoned consumer."""
+        abandoned consumer.  Every message discarded this way is counted in
+        ``dl4j_stream_dropped_total{topic}`` and surfaced by a rate-limited
+        warning naming the topic — silent data loss on a training stream is
+        a model-quality bug, not a transport detail."""
+        dropped = 0
         with self._lock:
             subs = list(self._topics.get(topic, []))
         for q in subs:
@@ -60,9 +73,36 @@ class MessageBroker:
                 except queue.Full:
                     try:
                         q.get_nowait()
+                        dropped += 1
                     except queue.Empty:
                         pass
+        if dropped:
+            self._count_drops(topic, dropped)
         return len(subs)
+
+    def _count_drops(self, topic: str, n: int) -> None:
+        reg = self._registry
+        if reg is None:
+            from deeplearning4j_tpu.observability import get_registry
+
+            reg = get_registry()
+        reg.counter(
+            _DROPPED, "Messages discarded because a subscriber queue was "
+            "full (oldest-first, bounded-lag semantics) — a consumer "
+            "falling behind its topic", labels=("topic",)
+        ).inc(n, topic=topic)
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_drop_warn.get(topic)
+            warn = last is None or now - last >= _DROP_WARN_INTERVAL_S
+            if warn:
+                self._last_drop_warn[topic] = now
+        if warn:
+            logger.warning(
+                "topic %r dropped %d message(s): a subscriber queue is full "
+                "(queue_size=%d) and the oldest messages were discarded — "
+                "see dl4j_stream_dropped_total{topic=%r}",
+                topic, n, self._queue_size, topic)
 
     # ---------------------------------------------------------- HTTP server
     def serve(self, port: int = 0, sub_idle_timeout: float = 300.0) -> int:

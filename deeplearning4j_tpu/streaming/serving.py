@@ -214,6 +214,10 @@ class InferenceServer:
                 try:
                     if self.path == "/predict":
                         self._predict()
+                    elif (self.path.startswith("/models/")
+                          and self.path.endswith("/rollback")):
+                        self._rollback(
+                            self.path[len("/models/"):-len("/rollback")])
                     elif self.path.startswith("/models/"):
                         self._swap(self.path[len("/models/"):])
                     else:
@@ -248,9 +252,16 @@ class InferenceServer:
                 obj = self._read_json()
                 try:
                     if isinstance(obj, dict) and "data" in obj:
-                        feats = base64_to_array(obj)
+                        # validate=True: an undecodable, shape-lying, or
+                        # NaN/Inf envelope is a structured 400 here — it
+                        # must never reach a forward pass it would share
+                        # a micro-batch with other clients' rows in
+                        feats = base64_to_array(obj, validate=True)
                     else:
                         feats = np.asarray(obj, np.float32)
+                        if not np.isfinite(feats).all():
+                            raise _BadRequest(
+                                "request payload contains NaN/Inf values")
                 except (ValueError, KeyError, TypeError) as e:
                     raise _BadRequest(f"bad request envelope: {e}")
                 try:
@@ -278,6 +289,20 @@ class InferenceServer:
                     # — the swap aborted and the fault is the artifact's,
                     # so classify as a client error, not a server fault
                     raise _BadRequest(f"cannot deploy checkpoint: {e}")
+                self._json({"model": mv.name, "version": mv.version,
+                            "state": mv.state})
+
+            def _rollback(self, name):
+                """POST /models/<name>/rollback — flip back to the version
+                retained by the last retaining hot-swap (the operator's
+                manual undo; the online pipeline's watch window calls the
+                same engine path automatically)."""
+                from deeplearning4j_tpu.serving import ModelNotFoundError
+
+                try:
+                    mv = server.engine.rollback(name)
+                except ModelNotFoundError as e:
+                    raise _BadRequest(str(e))
                 self._json({"model": mv.name, "version": mv.version,
                             "state": mv.state})
 

@@ -59,6 +59,12 @@ def pytest_configure(config):
         "profiling: performance-attribution tests — step profiler "
         "captures, XLA cost analysis / MFU gauges, request tracing, bench "
         "regression sentinel (python -m pytest -m profiling)")
+    config.addinivalue_line(
+        "markers",
+        "online: continuous-learning pipeline tests — stream consumption "
+        "with quarantine, windowed incremental fit, SLO-gated promotion, "
+        "canary, hot-swap watch + automatic rollback "
+        "(python -m pytest -m online)")
 
 
 def pytest_collection_modifyitems(config, items):

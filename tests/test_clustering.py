@@ -136,7 +136,7 @@ def test_exact_tsne_separates_blobs():
 
 def test_barnes_hut_tsne_separates_blobs():
     x, labels = blobs(n_per=25, scale=0.3)
-    ts = BarnesHutTsne(theta=0.5, perplexity=8, n_iter=250,
+    ts = BarnesHutTsne(theta=0.5, perplexity=8, n_iter=150,
                        learning_rate=100, seed=2)
     emb = ts.fit_transform(x)
     assert emb.shape == (75, 2)

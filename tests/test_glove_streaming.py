@@ -81,7 +81,7 @@ def test_glove_trains_out_of_core():
              .iterate(synthetic_corpus(400))
              .layer_size(24)
              .window_size(4)
-             .epochs(25)
+             .epochs(12)
              .learning_rate(0.1)
              .min_word_frequency(2)
              .seed(3)
@@ -124,7 +124,7 @@ def test_embedding_quality_metric(tmp_path):
            .epochs(8).min_word_frequency(2).seed(5).build())
     w2v.fit()
     glove = (Glove.Builder().iterate(corpus).layer_size(24).window_size(4)
-             .epochs(25).learning_rate(0.1).min_word_frequency(2).seed(3)
+             .epochs(12).learning_rate(0.1).min_word_frequency(2).seed(3)
              .max_memory_pairs(64).build())
     glove.fit()
 

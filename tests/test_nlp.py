@@ -243,7 +243,7 @@ def test_paragraph_vectors_labels_cluster():
           .min_word_frequency(1)
           .use_hierarchic_softmax(True)
           .learning_rate(0.2)
-          .epochs(20)
+          .epochs(12)
           .seed(5)
           .batch_size(64)
           .build())

@@ -43,10 +43,13 @@ def test_parallel_vocab_count_matches_serial():
 
 
 def test_one_device_mesh_matches_serial_word2vec():
+    # parity is epoch-count-invariant (both sides run the same schedule),
+    # so the oracle keeps full strength at the cheaper epoch budget
     sentences = synthetic_corpus(60)
-    serial = builder(Word2Vec, sentences).build().fit()
+    serial = builder(Word2Vec, sentences).epochs(3).build().fit()
     mesh1 = backend.default_mesh(devices=jax.devices()[:1])
-    dist = builder(DistributedWord2Vec, sentences).mesh(mesh1).build().fit()
+    dist = (builder(DistributedWord2Vec, sentences).epochs(3)
+            .mesh(mesh1).build().fit())
     np.testing.assert_allclose(np.asarray(serial.syn0),
                                np.asarray(dist.syn0), atol=1e-5)
 
@@ -57,10 +60,12 @@ def test_eight_device_mesh_matches_serial_word2vec():
     # reassociation aside) — the distributed==local oracle, on HS and NS
     sentences = synthetic_corpus(60)
     for hs, neg in ((True, 0), (False, 5)):
-        serial = (builder(Word2Vec, sentences)
+        # epoch count doesn't weaken the oracle: both sides run the same
+        # schedule and are compared to each other, not to a threshold
+        serial = (builder(Word2Vec, sentences).epochs(3)
                   .use_hierarchic_softmax(hs).negative_sample(neg)
                   .build().fit())
-        dist = (builder(DistributedWord2Vec, sentences)
+        dist = (builder(DistributedWord2Vec, sentences).epochs(3)
                 .use_hierarchic_softmax(hs).negative_sample(neg)
                 .mesh(backend.default_mesh()).build().fit())
         np.testing.assert_allclose(np.asarray(serial.syn0),
@@ -83,7 +88,7 @@ def test_distributed_glove_learns_structure():
              .iterate(synthetic_corpus(400))
              .layer_size(24)
              .window_size(4)
-             .epochs(25)
+             .epochs(12)
              .learning_rate(0.1)
              .min_word_frequency(2)
              .seed(3)

@@ -747,3 +747,171 @@ def test_serving_pipeline_routes_through_shared_engine(fresh_registry):
     assert fresh_registry.get_value("dl4j_serving_requests_total",
                                     status="ok") == 3
     assert fresh_registry.get("dl4j_serving_batch_rows").get().count >= 1
+
+
+# ------------------------------------------------- retain / rollback / canary
+
+def test_retaining_swap_rollback_under_load_zero_drops(fresh_registry):
+    """Satellite: hot-swap with retain_old keeps the previous version
+    loaded; rollback under concurrent load atomically flips back and
+    drops zero requests — every reply matches one of the two versions."""
+    net_a, net_b = small_net(seed=7), small_net(seed=99)
+    probe = np.linspace(0.0, 1.0, 8, dtype=np.float32).reshape(2, 4)
+    out_a = np.asarray(net_a.output(probe))
+    out_b = np.asarray(net_b.output(probe))
+    assert not np.allclose(out_a, out_b)
+    eng = ServingEngine(net_a, max_batch=8, max_wait_ms=1.0,
+                        example=np.zeros((4,), np.float32)).start()
+    stop_flag = threading.Event()
+    failures, served = [], [0]
+    lock = threading.Lock()
+
+    def client():
+        while not stop_flag.is_set():
+            try:
+                out = np.asarray(eng.predict(probe))
+                # a reply must be EXACTLY one version's output — a swap
+                # or rollback mid-flight may pick either, never a blend
+                if not (np.allclose(out, out_a, atol=1e-5)
+                        or np.allclose(out, out_b, atol=1e-5)):
+                    with lock:
+                        failures.append("blended output")
+                with lock:
+                    served[0] += 1
+            except Exception as e:
+                with lock:
+                    failures.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    [t.start() for t in threads]
+    try:
+        time.sleep(0.15)
+        mv_b = eng.deploy("default", net_b, retain_old=True,
+                          example=np.zeros((4,), np.float32))
+        retained = eng.models.retained("default")
+        assert retained is not None and retained.version == 1
+        assert retained.state == "retained"
+        assert retained.model is not None, "rollback target must stay loaded"
+        time.sleep(0.15)
+
+        restored = eng.rollback("default")
+        assert restored.version == 1
+        time.sleep(0.15)
+        stop_flag.set()
+        [t.join(timeout=30) for t in threads]
+
+        assert not failures, failures[:3]
+        assert served[0] > 20
+        # v1 serves again; the displaced bad version drained + retired
+        np.testing.assert_allclose(eng.predict(probe), out_a,
+                                   rtol=1e-5, atol=1e-6)
+        assert eng.models.active("default").version == 1
+        assert eng.models.retained("default") is None
+        retired = eng.stats()["models"]["retired"]
+        assert any(r["version"] == mv_b.version
+                   and r["state"] == "retired" for r in retired)
+        with pytest.raises(ModelNotFoundError):
+            eng.rollback("default")    # window closed
+    finally:
+        stop_flag.set()
+        eng.stop()
+
+
+def test_commit_swap_closes_rollback_window(fresh_registry):
+    eng = ServingEngine(small_net(seed=7), max_batch=8,
+                        example=np.zeros((4,), np.float32)).start()
+    try:
+        eng.deploy("default", small_net(seed=99), retain_old=True,
+                   example=np.zeros((4,), np.float32))
+        assert eng.models.retained("default") is not None
+        released = eng.commit_swap("default")
+        assert released.version == 1 and released.state == "retired"
+        assert released.model is None        # weights freed
+        assert eng.models.retained("default") is None
+        assert eng.commit_swap("default") is None   # idempotent
+        with pytest.raises(ModelNotFoundError):
+            eng.rollback("default")
+        # a second retaining swap opens a fresh window on the new pair
+        eng.deploy("default", small_net(seed=3), retain_old=True,
+                   example=np.zeros((4,), np.float32))
+        assert eng.models.retained("default").version == 2
+    finally:
+        eng.stop()
+
+
+def test_canary_routes_fraction_and_tears_down(fresh_registry):
+    net_a, net_b = small_net(seed=7), small_net(seed=99)
+    probe = np.linspace(0.0, 1.0, 8, dtype=np.float32).reshape(2, 4)
+    out_a = np.asarray(net_a.output(probe))
+    out_b = np.asarray(net_b.output(probe))
+    eng = ServingEngine(net_a, max_batch=8, max_wait_ms=1.0,
+                        example=np.zeros((4,), np.float32)).start()
+    try:
+        eng.start_canary("default", net_b, fraction=0.5, seed=11)
+        assert "default:canary" in eng.models.names()
+        hits = {"a": 0, "b": 0}
+        for _ in range(40):
+            out = np.asarray(eng.predict(probe))
+            hits["a" if np.allclose(out, out_a, atol=1e-5) else "b"] += 1
+        assert hits["a"] > 0 and hits["b"] > 0, hits
+        stats = eng.canary_stats("default")
+        assert stats["requests"] == hits["b"]
+        assert stats["ok"] == hits["b"] and stats["bad"] == 0
+
+        final = eng.stop_canary("default")
+        assert final["requests"] == hits["b"]
+        assert "default:canary" not in eng.models.names()
+        assert eng.canary_stats("default") is None
+        # all traffic back on the primary
+        for _ in range(10):
+            np.testing.assert_allclose(eng.predict(probe), out_a,
+                                       rtol=1e-5, atol=1e-6)
+        # primary was never displaced
+        assert eng.models.active("default").version == 1
+    finally:
+        eng.stop()
+
+
+def test_http_rollback_endpoint(fresh_registry):
+    from deeplearning4j_tpu.streaming import InferenceServer
+
+    eng = ServingEngine(small_net(seed=7), max_batch=8,
+                        example=np.zeros((4,), np.float32))
+    server = InferenceServer(engine=eng)
+    port = server.start()
+    try:
+        eng.deploy("default", small_net(seed=99), retain_old=True,
+                   example=np.zeros((4,), np.float32))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/models/default/rollback", data=b"{}")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            body = json.loads(resp.read())
+        assert body == {"model": "default", "version": 1, "state": "active"}
+        # nothing retained anymore: a second rollback is a structured 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_canary_teardown_race_falls_back_to_primary(fresh_registry):
+    """A request that took the canary route just as the canary registry
+    entry disappeared must fall back to the primary, not error — the
+    zero-drop contract outranks the traffic split."""
+    net_a, net_b = small_net(seed=7), small_net(seed=99)
+    probe = np.linspace(0.0, 1.0, 8, dtype=np.float32).reshape(2, 4)
+    out_a = np.asarray(net_a.output(probe))
+    eng = ServingEngine(net_a, max_batch=8, max_wait_ms=1.0,
+                        example=np.zeros((4,), np.float32)).start()
+    try:
+        eng.start_canary("default", net_b, fraction=1.0)
+        # simulate the unlucky interleaving: the route still exists (the
+        # request will take it) but the registry entry is already gone
+        mv = eng.models.remove("default:canary")
+        assert mv is not None
+        out = np.asarray(eng.predict(probe))     # must NOT raise
+        np.testing.assert_allclose(out, out_a, rtol=1e-5, atol=1e-6)
+        eng.stop_canary("default")
+    finally:
+        eng.stop()

@@ -6,6 +6,7 @@ import threading
 import urllib.request
 
 import numpy as np
+import pytest
 
 from deeplearning4j_tpu.datasets.dataset import DataSet
 from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
@@ -171,3 +172,97 @@ def test_serving_pipeline_round_trip():
     msg = out_q.get(timeout=2)
     pred = base64_to_array(json.loads(msg))
     assert pred.shape == (1, 2)
+
+
+def test_serde_consume_validation_rejects_bad_records():
+    """Satellite: consume-side validation — NaN/Inf payloads, dtype and
+    shape lies, and a bit-flipped base64 payload all raise a typed
+    BadRecordError with a bounded reason instead of reaching fit."""
+    from deeplearning4j_tpu.streaming import (
+        BadRecordError, consume_dataset_json,
+    )
+
+    ds = DataSet(np.ones((2, 3), np.float32), np.zeros((2, 2), np.float32))
+    msg = dataset_to_json(ds)
+    # the happy path round-trips (and returns the meta dict)
+    back, meta = consume_dataset_json(dataset_to_json(ds, meta={"ts": 1.0}))
+    np.testing.assert_allclose(back.features, ds.features)
+    assert meta == {"ts": 1.0}
+
+    def reason(text):
+        with pytest.raises(BadRecordError) as ei:
+            consume_dataset_json(text)
+        return ei.value.reason
+
+    # regression: a bit-flipped base64 character (payload corrupted in
+    # transit) must fail the STRICT decode, not be silently skipped
+    obj = json.loads(msg)
+    data = obj["features"]["data"]
+    i = next(idx for idx, c in enumerate(data) if c.islower())
+    obj["features"]["data"] = (data[:i] + chr(ord(data[i]) ^ 0x60)
+                               + data[i + 1:])
+    assert reason(json.dumps(obj)) == "bad_base64"
+
+    nan = DataSet(np.full((1, 3), np.nan, np.float32),
+                  np.zeros((1, 2), np.float32))
+    assert reason(dataset_to_json(nan)) == "non_finite"
+
+    obj = json.loads(msg)
+    obj["features"]["shape"] = [5, 7]          # payload-length lie
+    assert reason(json.dumps(obj)) == "shape_mismatch"
+
+    # 0-d arrays have no batch dimension: must quarantine, not TypeError
+    import base64 as b64
+
+    obj = json.loads(msg)
+    obj["features"] = {"shape": [], "dtype": "float32",
+                       "data": b64.b64encode(
+                           np.float32(1.0).tobytes()).decode()}
+    assert reason(json.dumps(obj)) == "shape_mismatch"
+
+    obj = json.loads(msg)
+    obj["features"]["dtype"] = "float64"
+    assert reason(json.dumps(obj)) == "bad_dtype"
+
+    obj = json.loads(msg)
+    del obj["labels"]
+    assert reason(json.dumps(obj)) == "bad_envelope"
+
+    assert reason("{{{not json") == "bad_json"
+
+    # rows mismatch between features and labels
+    obj = json.loads(dataset_to_json(
+        DataSet(np.ones((3, 2), np.float32), np.zeros((3, 2), np.float32))))
+    obj["labels"] = json.loads(msg)["labels"]  # 2 rows vs 3
+    assert reason(json.dumps(obj)) == "shape_mismatch"
+
+    # a shape-lying MASK must quarantine too, not crash fit mid-window
+    masked = DataSet(np.ones((2, 3), np.float32),
+                     np.zeros((2, 2), np.float32),
+                     labels_mask=np.ones((2,), np.float32))
+    obj = json.loads(dataset_to_json(masked))
+    obj["labels_mask"] = json.loads(dataset_to_json(DataSet(
+        np.ones((5, 1), np.float32),
+        np.zeros((5, 1), np.float32))))["features"]   # 5 rows vs 2
+    assert reason(json.dumps(obj)) == "shape_mismatch"
+
+    # the lenient legacy decode still accepts what it used to
+    assert dataset_from_json(msg).features.shape == (2, 3)
+
+
+def test_publish_counts_dropped_messages_per_topic():
+    """Satellite: a full subscriber queue drops the OLDEST message —
+    every drop lands in dl4j_stream_dropped_total{topic}."""
+    from deeplearning4j_tpu.observability import MetricsRegistry
+
+    reg = MetricsRegistry()
+    broker = MessageBroker(queue_size=2, registry=reg)
+    q = broker.subscribe("hot")
+    other = broker.subscribe("cold")
+    for i in range(5):
+        broker.publish("hot", str(i))
+    broker.publish("cold", "x")
+    assert [q.get_nowait() for _ in range(2)] == ["3", "4"]  # oldest gone
+    assert reg.get_value("dl4j_stream_dropped_total", topic="hot") == 3
+    assert reg.get_value("dl4j_stream_dropped_total", topic="cold") is None
+    assert other.get_nowait() == "x"
