@@ -85,6 +85,7 @@ class Counter:
 
     @property
     def value(self) -> float:
+        # dl4jlint: disable-next-line=lock-discipline -- monitoring read of a GIL-atomic float; scrapes tolerate one stale increment
         return self._value
 
 
@@ -100,10 +101,12 @@ class Gauge:
     def set(self, value: Any) -> None:
         """Store without conversion: an on-device scalar stays on device
         until scrape time (no sync in the hot loop)."""
+        # dl4jlint: disable-next-line=lock-discipline -- blind GIL-atomic reference publish from the single hot-loop writer; inc() locks because it read-modify-writes
         self._value = value
 
     def set_function(self, fn) -> None:
         """Gauge computed at scrape time (e.g. a queue depth)."""
+        # dl4jlint: disable-next-line=lock-discipline -- blind GIL-atomic reference publish (see set)
         self._value = fn
 
     def inc(self, amount: float = 1.0) -> None:
@@ -115,6 +118,7 @@ class Gauge:
 
     @property
     def value(self) -> float:
+        # dl4jlint: disable-next-line=lock-discipline -- monitoring read of a GIL-atomic reference; scrapes tolerate one stale set
         return _as_float(self._value)
 
 
@@ -168,18 +172,22 @@ class Histogram:
 
     @property
     def count(self) -> int:
+        # dl4jlint: disable-next-line=lock-discipline -- monitoring read of one GIL-atomic int; snapshot() is the consistent view
         return self._count
 
     @property
     def sum(self) -> float:
+        # dl4jlint: disable-next-line=lock-discipline -- monitoring read of one GIL-atomic float; snapshot() is the consistent view
         return self._sum
 
     @property
     def min(self) -> float:
+        # dl4jlint: disable-next-line=lock-discipline -- monitoring read; count/min may straddle an observe, snapshot() is the consistent view
         return self._min if self._count else float("nan")
 
     @property
     def max(self) -> float:
+        # dl4jlint: disable-next-line=lock-discipline -- monitoring read; count/max may straddle an observe, snapshot() is the consistent view
         return self._max if self._count else float("nan")
 
     def snapshot(self) -> Dict[str, Any]:

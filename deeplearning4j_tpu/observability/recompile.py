@@ -158,6 +158,7 @@ class RecompileDetector:
                 cost = {}
             with self._lock:
                 self._cost_by_sig[sig] = cost
+        # dl4jlint: disable-next-line=lock-discipline -- GIL-atomic reference publish; readers are monitoring-grade and tolerate the brief pre-cost window
         self.last_cost = cost
         # compiles land in the flight record too: "what happened right
         # before the hang" is usually a compile or a shape change
@@ -166,6 +167,7 @@ class RecompileDetector:
         )
 
         get_flight_recorder().record(
+            # dl4jlint: disable-next-line=lock-discipline -- reads back the ordinal this same call just assigned under the lock; a concurrent compile only skews the label
             "compile", fn=self.name, ordinal=self.compile_count,
             expected=bool(expected))
         if prev is not None and not expected:
@@ -187,6 +189,7 @@ class RecompileDetector:
         )
 
         ev: Dict[str, Any] = {
+            # dl4jlint: disable-next-line=lock-discipline -- flight-record label read; exactness not load-bearing
             "fn": self.name, "ordinal": self.compile_count,
             "signature": _fmt_signature(new),
             "evicted_signature": _fmt_signature(prev),
@@ -227,6 +230,7 @@ class RecompileDetector:
         delta = "; ".join(parts[:8]) or "signature changed"
         if len(parts) > 8:
             delta += f"; … {len(parts) - 8} more"
+        # dl4jlint: disable-next-line=lock-discipline -- warning-text label read; exactness not load-bearing
         return (f"recompile #{self.compile_count} of {self.name}: {delta} "
                 f"(each new signature costs an XLA compilation; pad/bucket "
                 f"inputs to stable shapes to avoid this)")

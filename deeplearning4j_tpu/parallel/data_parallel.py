@@ -113,6 +113,13 @@ class _WindowAssembler:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
+        if self._thread is not None:
+            # bounded join: the drain above freed the queue, so the
+            # producer reaches its sentinel promptly — and a re-iteration
+            # never races a half-dead assembler on the same queue
+            self._thread.join(timeout=5.0)
+            if not self._thread.is_alive():
+                self._thread = None
 
     def __iter__(self):
         try:

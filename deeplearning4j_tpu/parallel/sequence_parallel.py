@@ -280,6 +280,7 @@ class SequenceParallelTrainingMaster:
         kd = self.mesh.shape[backend.AXIS_DATA]
         ks = self.mesh.shape[backend.AXIS_SEQ]
         for ds in iterator:
+            # dl4jlint: disable-next-line=host-sync-in-hot-path -- iterator yields host numpy; asarray is a view, the device transfer is the explicit device_put below
             x, y = np.asarray(ds.features), np.asarray(ds.labels)
             if x.shape[0] % kd or x.shape[1] % ks:
                 raise ValueError(

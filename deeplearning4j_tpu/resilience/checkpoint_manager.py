@@ -267,11 +267,17 @@ class CheckpointManager:
     def request_priority_save(self) -> None:
         """Flag a priority save (async-signal-safe: plain attribute set).
         The next ``maybe_save``/``due`` honors it regardless of
-        intervals."""
+        intervals.  Deliberately lock-free: this runs inside the SIGTERM
+        handler, which executes on the main thread — if that thread
+        already holds ``_lock`` (mid-``save``), acquiring it here would
+        self-deadlock.  A one-way bool flip is atomic under the GIL and
+        ``save`` clears it under the lock afterwards."""
+        # dl4jlint: disable-next-line=lock-discipline -- signal-handler path: taking _lock here can self-deadlock; atomic bool publish
         self._priority = True
 
     def due(self, step: Optional[int] = None) -> Optional[str]:
         """The trigger that makes a save due now, or None."""
+        # dl4jlint: disable-next-line=lock-discipline -- atomic bool read of the signal-published flag; save() clears it under _lock
         if self._priority:
             return "priority"
         with self._lock:

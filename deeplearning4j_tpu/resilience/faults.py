@@ -81,10 +81,11 @@ class FaultInjector:
                      transient: bool = True) -> "FaultInjector":
         """Raise when a fit loop reaches global iteration ``step`` (fires
         ``times`` times, then disarms; ``component`` narrows to one loop)."""
-        self._step_rules.append({
-            "step": int(step), "component": component,
-            "times": int(times), "exc": exc, "transient": transient,
-        })
+        with self._lock:   # arming can race a live run's on_step scan
+            self._step_rules.append({
+                "step": int(step), "component": component,
+                "times": int(times), "exc": exc, "transient": transient,
+            })
         return self
 
     def on_step(self, component: str, step: int) -> None:
@@ -121,9 +122,10 @@ class FaultInjector:
                           ) -> "FaultInjector":
         """Kill the checkpoint writer after the ``n``-th staged file lands
         (n=1 → crash between the shard file and the manifest)."""
-        self._file_crash_after = int(n)
-        self._file_crash_exc = exc
-        self._files_seen = 0
+        with self._lock:   # the async writer thread reads these in
+            self._file_crash_after = int(n)   # on_checkpoint_file
+            self._file_crash_exc = exc
+            self._files_seen = 0
         return self
 
     def on_checkpoint_file(self, path: str) -> None:
@@ -144,15 +146,18 @@ class FaultInjector:
     def delay_worker(self, worker, seconds: float) -> "FaultInjector":
         """Make worker ``k`` look ``seconds`` slower to the telemetry seams
         (deterministic straggler)."""
-        self._worker_delays[str(worker)] = float(seconds)
+        with self._lock:   # elastic tests (re)arm this mid-run
+            self._worker_delays[str(worker)] = float(seconds)
         return self
 
     def worker_delay(self, worker) -> float:
-        return self._worker_delays.get(str(worker), 0.0)
+        with self._lock:
+            return self._worker_delays.get(str(worker), 0.0)
 
     def clear_worker_delay(self, worker) -> "FaultInjector":
         """Remove an armed ``delay_worker`` (the straggler recovered)."""
-        self._worker_delays.pop(str(worker), None)
+        with self._lock:
+            self._worker_delays.pop(str(worker), None)
         return self
 
     # ------------------------------------------------------ hung/dead workers
@@ -164,11 +169,14 @@ class FaultInjector:
         next window boundary.  ``until_step`` models the hang clearing on
         its own (deterministic re-admission tests); ``clear_worker`` does
         it explicitly."""
-        self._worker_states.append({
-            "worker": str(worker), "kind": "hung", "at_step": int(at_step),
-            "until_step": None if until_step is None else int(until_step),
-            "fired": False,
-        })
+        with self._lock:   # arming can race worker_state polls
+            self._worker_states.append({
+                "worker": str(worker), "kind": "hung",
+                "at_step": int(at_step),
+                "until_step": None if until_step is None
+                else int(until_step),
+                "fired": False,
+            })
         return self
 
     def kill_worker(self, worker, at_step: int, *,
@@ -176,11 +184,14 @@ class FaultInjector:
         """Worker ``k`` dies at global step ``at_step`` (state ``"dead"``
         — the per-worker SIGTERM / preempted-VM case).  ``until_step``
         models a replacement worker coming back for re-admission."""
-        self._worker_states.append({
-            "worker": str(worker), "kind": "dead", "at_step": int(at_step),
-            "until_step": None if until_step is None else int(until_step),
-            "fired": False,
-        })
+        with self._lock:
+            self._worker_states.append({
+                "worker": str(worker), "kind": "dead",
+                "at_step": int(at_step),
+                "until_step": None if until_step is None
+                else int(until_step),
+                "fired": False,
+            })
         return self
 
     def clear_worker(self, worker) -> "FaultInjector":
@@ -228,8 +239,9 @@ class FaultInjector:
         if mode == "drop_commit":
             path = os.path.join(directory, "COMMIT")
             os.remove(path)
-            self.injected.append({"kind": "corrupt", "mode": mode,
-                                  "path": path})
+            with self._lock:
+                self.injected.append({"kind": "corrupt", "mode": mode,
+                                      "path": path})
             return path
         shards = sorted(f for f in os.listdir(directory)
                         if f.startswith("shards-"))
@@ -241,7 +253,8 @@ class FaultInjector:
             with open(path, "r+b") as f:
                 f.truncate(max(1, size // 2))
         elif mode == "corrupt":
-            off = self.rng.randrange(max(1, size - 8))
+            with self._lock:   # reset() swaps self.rng concurrently
+                off = self.rng.randrange(max(1, size - 8))
             with open(path, "r+b") as f:
                 f.seek(off)
                 chunk = f.read(8)
@@ -249,7 +262,9 @@ class FaultInjector:
                 f.write(bytes(b ^ 0xFF for b in chunk))
         else:
             raise ValueError(f"unknown corruption mode {mode!r}")
-        self.injected.append({"kind": "corrupt", "mode": mode, "path": path})
+        with self._lock:
+            self.injected.append({"kind": "corrupt", "mode": mode,
+                                  "path": path})
         return path
 
     def reset(self) -> None:

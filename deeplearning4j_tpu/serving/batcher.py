@@ -71,6 +71,7 @@ class DynamicBatcher:
     # ------------------------------------------------------------ client side
     @property
     def queued(self) -> int:
+        # dl4jlint: disable-next-line=lock-discipline -- lock-free gauge read: GIL-atomic int, bound into dl4j_serving_queue_depth; must never contend with submit/dispatch
         return self._queued
 
     def queued_for(self, model: str) -> int:

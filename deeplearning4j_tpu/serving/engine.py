@@ -82,9 +82,10 @@ class _CanaryRoute:
             return self._rng.random() < self.fraction
 
     def record(self, status: str) -> None:
-        if status not in self.counts:
-            status = "shed" if status in ("queue_full", "shutdown") else "error"
         with self._lock:
+            if status not in self.counts:
+                status = ("shed" if status in ("queue_full", "shutdown")
+                          else "error")
             self.counts[status] += 1
 
     def as_dict(self) -> dict:
@@ -145,8 +146,14 @@ class ServingEngine:
         self._breakdown_lock = threading.Lock()
         self._breakdown_cap = 2048
         # name -> _CanaryRoute: a fraction of this model's traffic is
-        # diverted to a candidate version (see start_canary)
+        # diverted to a candidate version (see start_canary).  Routing
+        # lookups happen on every predict, so the map gets its own tiny
+        # lock instead of riding _swap_lock (whose holders may be deep in
+        # an XLA warmup); mutators hold BOTH: _swap_lock serialises the
+        # canary lifecycle, _canary_lock makes each map op atomic against
+        # the readers.  Lock order: _swap_lock outer, _canary_lock inner.
         self._canary: "dict[str, _CanaryRoute]" = {}
+        self._canary_lock = threading.Lock()
         # per-model outcome tallies (see status_counts)
         self._model_status: "dict[str, dict[str, int]]" = {}
 
@@ -199,7 +206,8 @@ class ServingEngine:
         and it is sampled as the exemplar onto the latency histogram."""
         trace_id = trace_id or new_trace_id()
         primary = model = model or self.default_model
-        route = self._canary.get(model)
+        with self._canary_lock:
+            route = self._canary.get(model)
         if route is not None and route.take():
             model = route.canary_model
         else:
@@ -509,8 +517,9 @@ class ServingEngine:
             except NoWarmupShapeError as e:
                 logger.warning("canary %s unwarmed: %s", mv.key, e)
             self.models.activate(mv)
-            self._canary[name] = _CanaryRoute(canary_name, fraction,
-                                              seed=seed)
+            with self._canary_lock:
+                self._canary[name] = _CanaryRoute(canary_name, fraction,
+                                                  seed=seed)
             get_flight_recorder().record(
                 "canary_start", model=name, version=mv.version,
                 fraction=fraction)
@@ -519,7 +528,8 @@ class ServingEngine:
             return mv
 
     def canary_stats(self, name: str) -> Optional[dict]:
-        route = self._canary.get(name)
+        with self._canary_lock:
+            route = self._canary.get(name)
         return route.as_dict() if route is not None else None
 
     def stop_canary(self, name: str, *,
@@ -532,7 +542,8 @@ class ServingEngine:
         canary was live.  The queue wait happens OUTSIDE the swap lock so
         deploys/rollbacks are never blocked behind a canary backlog."""
         with self._swap_lock:
-            route = self._canary.pop(name, None)
+            with self._canary_lock:
+                route = self._canary.pop(name, None)
             if route is None:
                 return None
             stats = route.as_dict()
@@ -568,6 +579,8 @@ class ServingEngine:
 
     def stats(self) -> dict:
         """Live engine state for the HTTP /models endpoint."""
+        with self._canary_lock:   # snapshot: start/stop_canary mutate
+            canaries = list(self._canary.items())
         return {
             "models": self.models.as_dict(),
             "queue_depth": self.batcher.queued,
@@ -577,7 +590,7 @@ class ServingEngine:
                             if self.policy.seq_buckets else None),
             "max_queue": self.admission.max_queue,
             "dispatcher_alive": self.batcher.is_alive(),
-            "canaries": {n: r.as_dict() for n, r in self._canary.items()},
+            "canaries": {n: r.as_dict() for n, r in canaries},
         }
 
     # ------------------------------------------------------------- execution

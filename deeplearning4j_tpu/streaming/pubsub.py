@@ -41,6 +41,8 @@ class MessageBroker:
         self._lock = threading.Lock()
         self._registry = registry
         self._last_drop_warn: Dict[str, float] = {}
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
 
     def subscribe(self, topic: str) -> "queue.Queue[str]":
         q: "queue.Queue[str]" = queue.Queue(maxsize=self._queue_size)
@@ -176,10 +178,16 @@ class MessageBroker:
         return self._httpd.server_address[1]
 
     def stop(self) -> None:
-        if getattr(self, "_httpd", None):
+        if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            # bounded join: shutdown() above unblocks serve_forever, so
+            # this returns promptly — without it a restart could race the
+            # old acceptor thread on the (reused) port
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 class NDArrayPublisher:

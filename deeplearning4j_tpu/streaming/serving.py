@@ -322,6 +322,11 @@ class InferenceServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if getattr(self, "_thread", None) is not None:
+            # shutdown() unblocked serve_forever — bounded join so a
+            # stop/start cycle never races the old acceptor thread
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 class _BadRequest(ValueError):

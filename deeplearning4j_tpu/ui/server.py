@@ -200,6 +200,11 @@ class UIServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            # shutdown() unblocked serve_forever — bounded join so a
+            # stop/start cycle never races the old acceptor thread
+            self._thread.join(timeout=5.0)
+            self._thread = None
 
 
 class RemoteStatsListener(IterationListener):

@@ -1,0 +1,3 @@
+# Makes scripts/ importable so ``python -m scripts.dl4jlint`` works from
+# the repo root; the standalone ``python scripts/<name>.py`` invocations
+# are unaffected.

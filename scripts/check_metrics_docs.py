@@ -1,139 +1,69 @@
 #!/usr/bin/env python
 """Lint: every registered metric family has help text and a docs row.
 
-Walks the ``deeplearning4j_tpu`` package (plus ``bench.py``) with ``ast``
-looking for registry family registrations — ``.counter(...)``,
-``.gauge(...)``, ``.histogram(...)`` calls whose first argument is a
-string literal starting with ``dl4j_`` — and enforces two invariants:
+THIN SHIM — the scan now lives in the dl4jlint framework as the
+``metrics-docs`` rule (``scripts/dl4jlint/rules/metrics_docs.py``) and
+runs with the rest of the suite via ``python -m scripts.dl4jlint``.
+This script keeps the original standalone entry point and its public
+functions (``find_registrations`` / ``documented_families`` /
+``run_lint``) so existing callers — ``tests/test_metrics_docs.py``
+loads it by file path — keep working unchanged.
 
-1. the registration passes a NON-EMPTY help string (literal second
-   positional argument or ``help=``) in at least one site — /metrics
-   output without HELP lines is useless to an operator;
-2. the family name appears in a table row (a line starting with ``|``)
-   of ``docs/observability.md`` — the docs table is the metric
-   catalogue, and a family that never made it there is invisible.
-
-No imports of the package (and no jax) — the scan is pure source
-analysis, so it runs in milliseconds and can't be defeated by lazy
-registration.  Wired into the tier-1 suite via
-``tests/test_metrics_docs.py``; run standalone with
-``python scripts/check_metrics_docs.py`` (exit 0 = clean).
+Run standalone with ``python scripts/check_metrics_docs.py``
+(exit 0 = clean), same contract as before.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import Dict, List, Set, Tuple
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "deeplearning4j_tpu")
-EXTRA_FILES = [os.path.join(REPO, "bench.py")]
-DOCS = os.path.join(REPO, "docs", "observability.md")
+if REPO not in sys.path:   # file-path loads have no package context
+    sys.path.insert(0, REPO)
 
-_METHODS = {"counter", "gauge", "histogram"}
-
-
-def _iter_py_files():
-    for root, _dirs, files in os.walk(PACKAGE):
-        for f in sorted(files):
-            if f.endswith(".py"):
-                yield os.path.join(root, f)
-    for f in EXTRA_FILES:
-        if os.path.exists(f):
-            yield f
+from scripts.dl4jlint.core import iter_source_files, load_contexts  # noqa: E402
+from scripts.dl4jlint.rules import metrics_docs as _rule  # noqa: E402
 
 
-def _literal_str(node) -> str | None:
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value
-    return None
+def _contexts():
+    return load_contexts(iter_source_files())
 
 
-def find_registrations() -> Dict[str, List[Tuple[str, int, bool]]]:
-    """family name -> [(file, line, has_help)] across the codebase."""
+def find_registrations(ctxs=None) -> Dict[str, List[Tuple[str, int, bool]]]:
+    """family name -> [(file, line, has_help)] across the codebase.
+    ``ctxs`` lets callers that already parsed the corpus (``main``)
+    reuse it instead of re-parsing 100+ files."""
+    if ctxs is None:
+        ctxs, _errors = _contexts()
     out: Dict[str, List[Tuple[str, int, bool]]] = {}
-    for path in _iter_py_files():
-        with open(path) as f:
-            src = f.read()
-        try:
-            tree = ast.parse(src, filename=path)
-        except SyntaxError as e:   # pragma: no cover - would fail tests too
-            print(f"{path}: unparsable: {e}", file=sys.stderr)
-            continue
-        rel = os.path.relpath(path, REPO)
-        # module-level string constants (the owning modules name their
-        # families via _FAMILY = "dl4j_..." so they register in one place)
-        consts: Dict[str, str] = {}
-        for node in tree.body:
-            if (isinstance(node, ast.Assign)
-                    and (s := _literal_str(node.value)) is not None):
-                for tgt in node.targets:
-                    if isinstance(tgt, ast.Name):
-                        consts[tgt.id] = s
-        for node in ast.walk(tree):
-            if not (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _METHODS and node.args):
-                continue
-            arg0 = node.args[0]
-            name = _literal_str(arg0)
-            if name is None and isinstance(arg0, ast.Name):
-                name = consts.get(arg0.id)
-            if not name or not name.startswith("dl4j_"):
-                continue
-            help_text = None
-            if len(node.args) > 1:
-                help_text = _literal_str(node.args[1])
-            for kw in node.keywords:
-                if kw.arg == "help":
-                    help_text = _literal_str(kw.value)
-            # adjacent string literals concatenate into one Constant, so a
-            # multi-line help renders as a single (truthy) literal here
-            has_help = bool(help_text and help_text.strip())
-            out.setdefault(name, []).append((rel, node.lineno, has_help))
+    for ctx in ctxs:
+        for name, sites in _rule.registrations_in(ctx.tree, ctx.rel).items():
+            out.setdefault(name, []).extend(sites)
     return out
 
 
 def documented_families() -> Set[str]:
     """dl4j_* names appearing in table rows of docs/observability.md."""
-    names: Set[str] = set()
-    with open(DOCS) as f:
-        for line in f:
-            if not line.lstrip().startswith("|"):
-                continue
-            for tok in line.replace("`", " ").replace("|", " ").split():
-                tok = tok.strip("*,.()/")
-                if tok.startswith("dl4j_"):
-                    names.add(tok)
-    return names
+    return _rule.documented_families()
 
 
-def run_lint() -> List[str]:
-    """Returns a list of violations (empty = clean)."""
-    problems: List[str] = []
-    regs = find_registrations()
-    if not regs:
-        return ["no dl4j_* metric registrations found — scanner broken?"]
-    docs = documented_families()
-    for name, sites in sorted(regs.items()):
-        if not any(has_help for _f, _l, has_help in sites):
-            where = ", ".join(f"{f}:{l}" for f, l, _ in sites[:3])
-            problems.append(
-                f"{name}: registered without non-empty help text ({where})")
-        if name not in docs:
-            problems.append(
-                f"{name}: no row in docs/observability.md metric table")
-    return problems
+def run_lint(loaded=None) -> List[str]:
+    """Returns a list of violations (empty = clean).  ``loaded`` is an
+    optional pre-parsed ``(ctxs, errors)`` pair (see ``main``)."""
+    ctxs, errors = loaded if loaded is not None else _contexts()
+    findings = list(_rule.MetricsDocsRule().finalize(ctxs))
+    return list(errors) + [f.message for f in findings]
 
 
 def main() -> int:
-    problems = run_lint()
+    loaded = _contexts()   # parse the corpus ONCE for both calls below
+    problems = run_lint(loaded)
     for p in problems:
         print(f"check_metrics_docs: {p}", file=sys.stderr)
     if not problems:
-        n = len(find_registrations())
+        n = len(find_registrations(loaded[0]))
         print(f"check_metrics_docs: OK ({n} dl4j_* families documented)")
     return 1 if problems else 0
 

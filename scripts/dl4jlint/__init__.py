@@ -1,0 +1,13 @@
+"""dl4jlint: the repo's JAX/TPU-aware static-analysis suite.
+
+Stdlib-only AST analysis (never imports jax), a rule API with
+per-line/per-file suppressions, and a ratcheting JSON baseline.  Run
+``python -m scripts.dl4jlint`` from the repo root; the rule catalogue,
+suppression syntax, and baseline runbook live in
+docs/static-analysis.md.
+"""
+
+from scripts.dl4jlint.core import (   # noqa: F401 — public API
+    ERROR, WARNING, FileContext, Finding, Rule,
+    iter_source_files, load_contexts, run_rules,
+)

@@ -1,0 +1,9 @@
+import sys
+
+from scripts.dl4jlint.cli import main
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:   # e.g. `... | head`; not an analysis failure
+        sys.exit(0)

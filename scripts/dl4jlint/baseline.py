@@ -1,0 +1,120 @@
+"""The ratcheting baseline: existing findings are debt, new ones are
+failures, and the recorded count can only go down.
+
+``baseline.json`` holds one entry per ``(rule, path, symbol)`` key with
+the count of accepted findings under that key and an optional ``why``
+justification (required by review for anything deliberately kept, e.g.
+"cold path: end-of-fit summary").  Keys deliberately exclude line
+numbers so unrelated edits don't churn the file.
+
+Semantics:
+
+- ``compare``: findings beyond a key's baselined count are NEW (CI
+  fails); baselined keys whose current count shrank are STALE (a
+  friendly nudge to run ``--update-baseline`` and bank the progress).
+- ``update``: rewrites counts to the current state, carrying ``why``
+  forward — but REFUSES (RatchetError) when any key grew or appeared,
+  so the baseline can never absorb a regression; fix or suppress it
+  instead.  Bootstrapping a missing baseline file is the one exception.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from scripts.dl4jlint.core import Finding
+
+Key = Tuple[str, str, str]
+
+VERSION = 1
+
+
+class RatchetError(Exception):
+    """--update-baseline refused: the baseline never grows."""
+
+
+def empty() -> dict:
+    return {"version": VERSION, "entries": []}
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != VERSION or "entries" not in doc:
+        raise ValueError(f"{path}: not a dl4jlint baseline (version "
+                         f"{VERSION} with an 'entries' list expected)")
+    for e in doc["entries"]:
+        missing = {"rule", "path", "symbol", "count"} - set(e)
+        if missing:
+            raise ValueError(f"{path}: baseline entry {e!r} missing "
+                             f"{sorted(missing)}")
+    return doc
+
+
+def save(path: str, doc: dict) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def _entry_map(doc: dict) -> "OrderedDict[Key, dict]":
+    out: "OrderedDict[Key, dict]" = OrderedDict()
+    for e in doc["entries"]:
+        out[(e["rule"], e["path"], e["symbol"])] = e
+    return out
+
+
+def _current_counts(findings: Sequence[Finding]) -> Dict[Key, int]:
+    counts: Dict[Key, int] = {}
+    for f in findings:
+        counts[f.key] = counts.get(f.key, 0) + 1
+    return counts
+
+
+def compare(findings: Sequence[Finding],
+            doc: dict) -> Tuple[List[Finding], List[Key]]:
+    """(new findings beyond the baseline, stale over-budgeted keys)."""
+    allowed = {k: e["count"] for k, e in _entry_map(doc).items()}
+    seen: Dict[Key, int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        seen[f.key] = seen.get(f.key, 0) + 1
+        if seen[f.key] > allowed.get(f.key, 0):
+            new.append(f)
+    stale = [k for k, budget in allowed.items()
+             if seen.get(k, 0) < budget]
+    return new, stale
+
+
+def update(findings: Sequence[Finding],
+           doc: Optional[dict]) -> dict:
+    """New baseline doc at current counts.  Raises RatchetError when any
+    key grew (or appeared) relative to ``doc``; ``doc=None`` bootstraps
+    a first baseline and accepts everything."""
+    counts = _current_counts(findings)
+    if doc is not None:
+        old = {k: e["count"] for k, e in _entry_map(doc).items()}
+        grown = sorted(k for k, n in counts.items() if n > old.get(k, 0))
+        if grown:
+            lines = [f"  {r} {p} :: {s} ({old.get((r, p, s), 0)} -> "
+                     f"{counts[(r, p, s)]})" for r, p, s in grown]
+            raise RatchetError(
+                "refusing to grow the baseline — fix or suppress these "
+                "first:\n" + "\n".join(lines))
+        whys = {k: e.get("why") for k, e in _entry_map(doc).items()}
+    else:
+        whys = {}
+    entries = []
+    for key in sorted(counts):
+        rule, path, symbol = key
+        e = {"rule": rule, "path": path, "symbol": symbol,
+             "count": counts[key]}
+        if whys.get(key):
+            e["why"] = whys[key]
+        entries.append(e)
+    return {"version": VERSION, "entries": entries}

@@ -1,0 +1,170 @@
+"""Shared jit-graph scan: which functions does XLA trace, and which
+module symbols are bound to jitted callables?
+
+Both the host-sync and recompile-hazard rules need the same inventory of
+a module's jit surface, built once per file:
+
+- ``traced``: function/lambda AST nodes whose BODY is traced by XLA —
+  ``@jax.jit``-decorated (directly or via ``partial(jax.jit, ...)``),
+  passed to a ``jax.jit(...)`` call (possibly through ``grad`` /
+  ``value_and_grad`` / ``vmap`` / ``pmap`` wrappers), or a lambda inside
+  one.
+- ``jitted_symbols``: names a jitted callable is bound to — ``step =
+  jax.jit(f)`` or ``self._step = instrument(jax.jit(f), ...)`` — mapped
+  to whether the jit call passed ``static_argnums``/``static_argnames``.
+  Calls through these symbols are the per-step hot invocations the
+  recompile rule audits and the host-sync rule uses to mark hot loops.
+- ``jit_calls``: every ``jax.jit(...)`` Call node (for placement checks:
+  jit-inside-a-loop, jit-invoked-immediately).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from scripts.dl4jlint.core import dotted_name
+
+_JIT_NAMES = {"jax.jit", "jit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+_TRANSFORMS = {"jax.grad", "jax.value_and_grad", "jax.vmap", "jax.pmap",
+               "grad", "value_and_grad", "vmap", "pmap"}
+
+
+def is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` or ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted_name(node.func)
+    if d in _JIT_NAMES:
+        return True
+    return (d in _PARTIAL_NAMES and node.args
+            and dotted_name(node.args[0]) in _JIT_NAMES)
+
+
+def is_direct_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` only — NOT ``partial(jax.jit, ...)``, which is a
+    constructor whose result is normally bound and reused (the
+    ``step = partial(jax.jit, donate_argnums=...)(fn)`` binding idiom
+    must not read as invoke-immediately)."""
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in _JIT_NAMES)
+
+
+def _jit_kwargs(node: ast.Call) -> List[ast.keyword]:
+    return node.keywords
+
+
+def has_static_args(node: ast.Call) -> bool:
+    return any(kw.arg in ("static_argnums", "static_argnames")
+               for kw in node.keywords)
+
+
+def _unwrap_traced_arg(node: ast.AST) -> Optional[ast.AST]:
+    """The function expression jax ultimately traces: unwraps transform
+    calls like ``jax.jit(jax.value_and_grad(f))`` down to ``f``."""
+    while (isinstance(node, ast.Call)
+           and dotted_name(node.func) in _TRANSFORMS and node.args):
+        node = node.args[0]
+    if isinstance(node, (ast.Lambda, ast.Name)):
+        return node
+    return None
+
+
+def _binding_symbol(target: ast.AST) -> Optional[str]:
+    """``x`` or ``self.attr`` as a string symbol, else None."""
+    if isinstance(target, ast.Name):
+        return target.id
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return f"self.{target.attr}"
+    return None
+
+
+@dataclass
+class JitScan:
+    traced: List[ast.AST] = field(default_factory=list)
+    jitted_symbols: Dict[str, bool] = field(default_factory=dict)  # -> static?
+    jit_calls: List[ast.Call] = field(default_factory=list)
+
+    def symbol_of_call(self, call: ast.Call) -> Optional[str]:
+        """The jitted symbol a Call invokes, or None."""
+        sym = _binding_symbol(call.func)
+        if sym is not None and sym in self.jitted_symbols:
+            return sym
+        return None
+
+
+def scan(ctx) -> JitScan:
+    """The module's JitScan, computed once per file and cached on the
+    FileContext (both the host-sync and recompile rules need it)."""
+    hit = ctx.cache.get("jitscan")
+    if hit is None:
+        hit = ctx.cache["jitscan"] = _scan_nodes(ctx.nodes)
+    return hit
+
+
+def scan_module(tree: ast.Module) -> JitScan:
+    return _scan_nodes(list(ast.walk(tree)))
+
+
+def _scan_nodes(nodes: List[ast.AST]) -> JitScan:
+    scan = JitScan()
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    traced_names: Set[str] = set()
+    traced_nodes: List[ast.AST] = []
+
+    for node in nodes:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                if (dotted_name(dec) in _JIT_NAMES
+                        or (isinstance(dec, ast.Call) and is_jit_call(dec))):
+                    traced_nodes.append(node)
+        if is_jit_call(node):
+            scan.jit_calls.append(node)
+            # partial(jax.jit, f): traced arg is args[1]; jax.jit(f): args[0]
+            args = (node.args[1:] if dotted_name(node.func) in _PARTIAL_NAMES
+                    else node.args)
+            if args:
+                fn = _unwrap_traced_arg(args[0])
+                if isinstance(fn, ast.Lambda):
+                    traced_nodes.append(fn)
+                elif isinstance(fn, ast.Name):
+                    traced_names.add(fn.id)
+
+    for name in traced_names:
+        traced_nodes.extend(defs_by_name.get(name, ()))
+    scan.traced = traced_nodes
+
+    # symbol bindings: assignments whose value subtree holds a jit call
+    for node in nodes:
+        if not isinstance(node, ast.Assign):
+            continue
+        jits = [n for n in ast.walk(node.value) if is_jit_call(n)]
+        if not jits:
+            continue
+        static = any(has_static_args(j) for j in jits)
+        for tgt in node.targets:
+            sym = _binding_symbol(tgt)
+            if sym is not None:
+                scan.jitted_symbols[sym] = static
+    return scan
+
+
+def hot_loops(ctx, scan: JitScan) -> List[ast.AST]:
+    """For/While loops whose body invokes a jitted symbol — the per-step
+    regions where a host sync costs throughput every iteration.  Found
+    by climbing parents from each jitted call (one pass, no re-walks)."""
+    out: List[ast.AST] = []
+    seen: Set[int] = set()
+    for node in ctx.nodes:
+        if not (isinstance(node, ast.Call) and scan.symbol_of_call(node)):
+            continue
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.For, ast.While)) and id(anc) not in seen:
+                seen.add(id(anc))
+                out.append(anc)
+    return out

@@ -1,0 +1,35 @@
+"""Rule registry.  Add a rule: write a module here, subclass ``Rule``,
+append an instance to ``ALL_RULES`` (docs/static-analysis.md walks
+through it)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from scripts.dl4jlint.core import Rule
+from scripts.dl4jlint.rules.host_sync import HostSyncRule
+from scripts.dl4jlint.rules.lock_discipline import LockDisciplineRule
+from scripts.dl4jlint.rules.metrics_docs import MetricsDocsRule
+from scripts.dl4jlint.rules.recompile import RecompileHazardRule
+from scripts.dl4jlint.rules.rng_reuse import RngReuseRule
+from scripts.dl4jlint.rules.thread_hygiene import ThreadHygieneRule
+
+ALL_RULES: List[Rule] = [
+    HostSyncRule(),
+    RecompileHazardRule(),
+    LockDisciplineRule(),
+    RngReuseRule(),
+    ThreadHygieneRule(),
+    MetricsDocsRule(),
+]
+
+
+def get_rules(names: Sequence[str] = ()) -> List[Rule]:
+    if not names:
+        return list(ALL_RULES)
+    by_name = {r.name: r for r in ALL_RULES}
+    missing = [n for n in names if n not in by_name]
+    if missing:
+        raise KeyError(f"unknown rule(s): {', '.join(missing)} "
+                       f"(known: {', '.join(sorted(by_name))})")
+    return [by_name[n] for n in names]

@@ -1,0 +1,91 @@
+"""host-sync-in-hot-path: device->host synchronisation inside code XLA
+is supposed to keep on-device.
+
+Two hot regions are audited (see ``jitscan``):
+
+1. **jit-traced function bodies** — ``.item()``, ``float(x)`` /
+   ``int(x)`` on a non-constant, ``np.asarray`` / ``np.array``, and
+   ``.block_until_ready()`` inside a function jax traces either breaks
+   tracing outright (ConcretizationTypeError at the first non-trivial
+   input) or silently forces a transfer at trace time;
+2. **hot loops** — loop bodies that invoke a jitted step callable.
+   There, every ``.item()`` / ``np.asarray`` / ``block_until_ready``
+   blocks the Python thread on the device ONCE PER STEP, serialising
+   dispatch against execution — exactly the throughput leak the PR-1
+   lazy-score work removed from the fit loops.  ``float()`` / ``int()``
+   are only flagged in loops when applied directly to a jitted call's
+   result (``float(self._step(...))``) — coercing unrelated Python
+   scalars per step is ugly but free.
+
+Cold-path conversions (end-of-fit summaries, checkpoint snapshots,
+test utilities) are expected findings: baseline them with a ``why``
+rather than suppressing, so the ratchet keeps the inventory visible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from scripts.dl4jlint.core import FileContext, Finding, Rule, dotted_name
+from scripts.dl4jlint import jitscan
+
+_SYNC_ATTRS = {"item", "block_until_ready"}
+_NP_FUNCS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "onp.asarray", "onp.array"}
+
+
+class HostSyncRule(Rule):
+    name = "host-sync-in-hot-path"
+    description = ("device->host sync (.item()/float()/int()/np.asarray/"
+                   "block_until_ready) inside a jit-traced function or a "
+                   "loop driving a jitted step")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        scan = jitscan.scan(ctx)
+        findings: List[Finding] = []
+        seen: set = set()
+
+        def emit(node: ast.AST, what: str, where: str) -> None:
+            if node.lineno in seen:
+                return
+            seen.add(node.lineno)
+            findings.append(self.finding(
+                ctx, node.lineno,
+                f"{what} forces a device sync {where}"))
+
+        for fn in scan.traced:
+            for node in ast.walk(fn):
+                what = self._sync_call(node, in_loop=False, scan=scan)
+                if what:
+                    emit(node, what, "inside a jit-traced function")
+        for loop in jitscan.hot_loops(ctx, scan):
+            for node in ast.walk(loop):
+                what = self._sync_call(node, in_loop=True, scan=scan)
+                if what:
+                    emit(node, what,
+                         "every iteration of a loop driving a jitted step")
+        return findings
+
+    # ------------------------------------------------------------- matching
+    def _sync_call(self, node: ast.AST, in_loop: bool,
+                   scan: jitscan.JitScan) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_ATTRS:
+            return f".{func.attr}()"
+        d = dotted_name(func)
+        if d in _NP_FUNCS:
+            return f"{d}()"
+        if d in ("float", "int") and len(node.args) == 1:
+            arg = node.args[0]
+            if in_loop:
+                # only float(jitted_step(...)) — a direct per-step coercion
+                if (isinstance(arg, ast.Call)
+                        and scan.symbol_of_call(arg) is not None):
+                    return f"{d}() on a jitted step's result"
+                return None
+            if not isinstance(arg, ast.Constant):
+                return f"{d}()"
+        return None

@@ -1,0 +1,215 @@
+"""lock-discipline: a heuristic race detector for the repo's
+``with self._lock`` convention.
+
+For every class that creates ``threading.Lock``/``RLock``/``Condition``
+attributes in ``__init__``, collect each instance attribute WRITTEN
+under a ``with self.<lock>:`` block in any method.  Such an attribute is
+declared lock-guarded; any read or write of it OUTSIDE a lock block in a
+different place is then a suspected race and is reported.
+
+Recognised conventions (no finding):
+
+- ``__init__`` constructs freely (happens-before publication);
+- methods whose name ends in ``_locked``, or whose docstring contains
+  "lock held" / "Lock held", are by convention only called with the
+  lock already taken — their whole body counts as guarded;
+- deliberately lock-free monitoring reads (single-writer counters, dict
+  snapshots relying on the GIL) get an inline
+  ``# dl4jlint: disable=lock-discipline -- <invariant>`` stating WHY the
+  unlocked access is sound, which is the documentation the next reader
+  needs anyway.
+
+This is a heuristic: it reasons per-class and per-module, does not track
+aliasing, and treats any ``with self.<lock-attr>`` (including Conditions)
+as the guard.  It found real bugs at introduction (see
+docs/static-analysis.md), which is the bar it has to keep clearing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Set, Tuple
+
+from scripts.dl4jlint.core import FileContext, Finding, Rule, dotted_name
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+_LOCK_HELD_RE = re.compile(r"lock held", re.IGNORECASE)
+
+# method calls that mutate a container in place — ``self._m.pop(k)`` is a
+# write to ``self._m`` just like ``self._m[k] = v``
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add", "remove",
+             "discard", "pop", "popleft", "popitem", "clear", "update",
+             "setdefault", "move_to_end", "sort", "reverse"}
+
+
+@dataclass(frozen=True)
+class _Access:
+    attr: str
+    method: str
+    line: int
+    store: bool
+    locked: bool
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("attribute written under `with self._lock` in one "
+                   "method but accessed without the lock elsewhere in "
+                   "the class")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(ctx, node))
+        return findings
+
+    # ------------------------------------------------------------ per class
+    def _check_class(self, ctx: FileContext,
+                     cls: ast.ClassDef) -> List[Finding]:
+        lock_attrs = self._lock_attrs(cls)
+        if not lock_attrs:
+            return []
+        container_attrs = self._container_attrs(cls)
+        accesses: List[_Access] = []
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                held = (item.name.endswith("_locked")
+                        or bool(_LOCK_HELD_RE.search(
+                            ast.get_docstring(item) or "")))
+                self._walk(item, item.name, lock_attrs, container_attrs,
+                           held, accesses)
+
+        guarded: Dict[str, Tuple[str, int]] = {}
+        for a in accesses:
+            if a.store and a.locked and a.method != "__init__":
+                guarded.setdefault(a.attr, (a.method, a.line))
+
+        findings: List[Finding] = []
+        reported: Set[Tuple[str, str]] = set()
+        for a in accesses:
+            if (a.attr in guarded and not a.locked
+                    and a.method != "__init__"
+                    and (a.attr, a.method) not in reported):
+                reported.add((a.attr, a.method))
+                gm, gl = guarded[a.attr]
+                findings.append(self.finding(
+                    ctx, a.line,
+                    f"self.{a.attr} is written under a lock in "
+                    f"{cls.name}.{gm} (line {gl}) but "
+                    f"{'written' if a.store else 'read'} without it here — "
+                    f"take the lock, or state the lock-free invariant in a "
+                    f"suppression comment",
+                    symbol=f"{cls.name}.{a.method}.{a.attr}"))
+        return findings
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        attrs: Set[str] = set()
+        for item in cls.body:
+            if (isinstance(item, ast.FunctionDef)
+                    and item.name == "__init__"):
+                for node in ast.walk(item):
+                    if (isinstance(node, ast.Assign)
+                            and isinstance(node.value, ast.Call)
+                            and dotted_name(node.value.func) in _LOCK_CTORS):
+                        for tgt in node.targets:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                attrs.add(tgt.attr)
+        return attrs
+
+    def _container_attrs(self, cls: ast.ClassDef) -> Set[str]:
+        """Attributes initialised as plain containers anywhere in the
+        class — the only ones whose in-place mutations (``self._m[k] =``,
+        ``self._m.pop(...)``) count as writes.  Mutator-named METHOD
+        calls on arbitrary domain objects (``self.models.remove(name)``
+        where models is a thread-safe registry) must not."""
+        ctors = {"dict", "list", "set", "deque", "OrderedDict",
+                 "defaultdict", "Counter", "collections.OrderedDict",
+                 "collections.deque", "collections.defaultdict",
+                 "collections.Counter"}
+        attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):   # self._m: Dict[...] = {}
+                targets = [node.target]
+            else:
+                continue
+            v = node.value
+            is_container = (isinstance(v, (ast.Dict, ast.List, ast.Set,
+                                           ast.DictComp, ast.ListComp,
+                                           ast.SetComp))
+                            or (isinstance(v, ast.Call)
+                                and dotted_name(v.func) in ctors))
+            if not is_container:
+                continue
+            for tgt in targets:
+                attr = self._self_attr(tgt)
+                if attr is not None:
+                    attrs.add(attr)
+        return attrs
+
+    # ------------------------------------------------- lock-aware traversal
+    def _walk(self, node: ast.AST, method: str, lock_attrs: Set[str],
+              container_attrs: Set[str], locked: bool,
+              out: List[_Access]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.With):
+                takes = any(
+                    isinstance(i.context_expr, ast.Attribute)
+                    and isinstance(i.context_expr.value, ast.Name)
+                    and i.context_expr.value.id == "self"
+                    and i.context_expr.attr in lock_attrs
+                    for i in child.items)
+                for i in child.items:
+                    self._walk(i.context_expr, method, lock_attrs,
+                               container_attrs, locked, out)
+                for stmt in child.body:
+                    self._walk(stmt, method, lock_attrs, container_attrs,
+                               locked or takes, out)
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # nested callables (dispatch closures, HTTP handlers) run
+                # on other threads with unknown lock state — skip them
+                continue
+            self._record(child, method, lock_attrs, container_attrs,
+                         locked, out)
+            self._walk(child, method, lock_attrs, container_attrs, locked,
+                       out)
+
+    @staticmethod
+    def _self_attr(node: ast.AST) -> "str | None":
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _record(self, node: ast.AST, method: str, lock_attrs: Set[str],
+                container_attrs: Set[str], locked: bool,
+                out: List[_Access]) -> None:
+        attr = self._self_attr(node)
+        if attr is not None and attr not in lock_attrs:
+            out.append(_Access(attr, method, node.lineno,
+                               isinstance(node.ctx, (ast.Store, ast.Del)),
+                               locked))
+            return
+        # container writes: ``self._m[k] = v`` / ``del self._m[k]`` /
+        # ``self._m.pop(k)`` mutate self._m without an Attribute Store
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, (ast.Store, ast.Del))):
+            attr = self._self_attr(node.value)
+            if attr in container_attrs and attr not in lock_attrs:
+                out.append(_Access(attr, method, node.lineno, True, locked))
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS):
+            attr = self._self_attr(node.func.value)
+            if attr in container_attrs and attr not in lock_attrs:
+                out.append(_Access(attr, method, node.lineno, True, locked))

@@ -1,0 +1,130 @@
+"""metrics-docs: every registered dl4j_* metric family has help text and
+a docs/observability.md table row.
+
+The original standalone lint (``scripts/check_metrics_docs.py``, now a
+shim over this rule) predates the dl4jlint framework; its scan logic
+lives here unchanged in substance:
+
+1. every ``.counter(...)`` / ``.gauge(...)`` / ``.histogram(...)``
+   registration whose family name starts with ``dl4j_`` must pass a
+   non-empty help string at least once across the codebase;
+2. every family must appear in a table row of the metric catalogue in
+   ``docs/observability.md``.
+
+Runs project-level (``finalize``): help-text sites for one family may be
+spread across files, so per-file checking can't decide anything.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from scripts.dl4jlint.core import (
+    REPO, FileContext, Finding, Rule,
+)
+
+_METHODS = {"counter", "gauge", "histogram"}
+DOCS = os.path.join(REPO, "docs", "observability.md")
+
+# (rel path, line, has_help) per family
+Registration = Tuple[str, int, bool]
+
+
+def _literal_str(node) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def registrations_in(tree: ast.Module,
+                     rel: str) -> Dict[str, List[Registration]]:
+    """family -> registration sites in one parsed module."""
+    out: Dict[str, List[Registration]] = {}
+    # module-level string constants (owning modules name their families
+    # via _FAMILY = "dl4j_..." so they register in one place)
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign)
+                and (s := _literal_str(node.value)) is not None):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    consts[tgt.id] = s
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _METHODS and node.args):
+            continue
+        arg0 = node.args[0]
+        name = _literal_str(arg0)
+        if name is None and isinstance(arg0, ast.Name):
+            name = consts.get(arg0.id)
+        if not name or not name.startswith("dl4j_"):
+            continue
+        help_text = None
+        if len(node.args) > 1:
+            help_text = _literal_str(node.args[1])
+        for kw in node.keywords:
+            if kw.arg == "help":
+                help_text = _literal_str(kw.value)
+        # adjacent string literals concatenate into one Constant, so a
+        # multi-line help renders as a single (truthy) literal here
+        has_help = bool(help_text and help_text.strip())
+        out.setdefault(name, []).append((rel, node.lineno, has_help))
+    return out
+
+
+def documented_families(docs_path: str = DOCS) -> Set[str]:
+    """dl4j_* names appearing in table rows of docs/observability.md."""
+    names: Set[str] = set()
+    with open(docs_path, encoding="utf-8") as f:
+        for line in f:
+            if not line.lstrip().startswith("|"):
+                continue
+            for tok in line.replace("`", " ").replace("|", " ").split():
+                tok = tok.strip("*,.()/")
+                if tok.startswith("dl4j_"):
+                    names.add(tok)
+    return names
+
+
+class MetricsDocsRule(Rule):
+    name = "metrics-docs"
+    description = ("registered dl4j_* metric family lacks help text or a "
+                   "docs/observability.md table row")
+
+    def __init__(self, docs_path: str = DOCS):
+        self.docs_path = docs_path
+
+    def finalize(self, ctxs: Sequence[FileContext]) -> Iterable[Finding]:
+        regs: Dict[str, List[Registration]] = {}
+        for ctx in ctxs:
+            for name, sites in registrations_in(ctx.tree, ctx.rel).items():
+                regs.setdefault(name, []).extend(sites)
+        findings: List[Finding] = []
+        in_package = any(c.rel.startswith("deeplearning4j_tpu/")
+                         for c in ctxs)
+        if not regs:
+            if in_package:
+                c0 = ctxs[0]
+                findings.append(self.finding(
+                    c0, 1, "no dl4j_* metric registrations found in the "
+                    "package — scanner broken?", symbol="<corpus>"))
+            return findings
+        docs = (documented_families(self.docs_path)
+                if os.path.exists(self.docs_path) else set())
+        for name, sites in sorted(regs.items()):
+            path, line, _ = sites[0]
+            if not any(h for _f, _l, h in sites):
+                where = ", ".join(f"{f}:{l}" for f, l, _ in sites[:3])
+                findings.append(Finding(
+                    self.name, path, line, name,
+                    f"{name}: registered without non-empty help text "
+                    f"({where})"))
+            if name not in docs:
+                findings.append(Finding(
+                    self.name, path, line, name,
+                    f"{name}: no row in docs/observability.md metric "
+                    f"table"))
+        return findings

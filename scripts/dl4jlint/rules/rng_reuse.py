@@ -1,0 +1,190 @@
+"""rng-key-reuse: the same PRNG key consumed by two ``jax.random`` calls.
+
+JAX keys are use-once: feeding one key to two random ops yields
+correlated (often identical) streams, and the PR-5 retry/RNG-rewind
+semantics additionally assume every consumed key was minted by exactly
+one ``split``/``fold_in`` step.  This rule does a statement-order scan
+of each function body:
+
+- passing a variable as the FIRST argument of any ``jax.random.*`` call
+  (except ``PRNGKey``) marks it consumed;
+- re-assigning the variable (``rng, sub = jax.random.split(rng)``)
+  clears it;
+- consuming an already-consumed variable is a finding.
+
+Control flow is approximated: ``if``/``else`` branches are scanned with
+independent copies of the state (a key consumed in only one branch is
+not double-use), loop bodies are scanned twice so loop-carried reuse
+(``for ...: jax.random.normal(key, ...)`` without a split inside the
+loop) is caught on the simulated second iteration.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from scripts.dl4jlint.core import FileContext, Finding, Rule, dotted_name
+
+_CREATORS = {"PRNGKey", "key"}   # jax.random.key is the new-style creator
+
+
+def _terminates(stmts) -> bool:
+    """True when a statement list always leaves the enclosing block
+    (so its PRNG state never merges past the conditional)."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+
+def _scoped_walk(root: ast.AST):
+    """ast.walk that does NOT descend into nested function/lambda
+    bodies: a jax.random call inside ``lambda s: normal(key, s)`` runs
+    when the lambda is CALLED, not where it is defined, so it must not
+    mark ``key`` consumed in the enclosing statement order."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.Lambda, ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
+
+
+class RngReuseRule(Rule):
+    name = "rng-key-reuse"
+    description = ("the same PRNG key variable consumed by two "
+                   "jax.random calls without an intervening split")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        prefixes = self._random_prefixes(ctx.tree)
+        if not prefixes:
+            return []
+        findings: List[Finding] = []
+        for node in ctx.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_function(ctx, node, prefixes, findings)
+        return findings
+
+    # ----------------------------------------------------------- module prep
+    def _random_prefixes(self, tree: ast.Module) -> Set[str]:
+        """Dotted prefixes that denote jax.random in this module."""
+        prefixes: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        prefixes.add(f"{a.asname or 'jax'}.random")
+                    elif a.name == "jax.random":
+                        prefixes.add(a.asname or "jax.random")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name == "random":
+                            prefixes.add(a.asname or "random")
+        return prefixes
+
+    # ------------------------------------------------------- function scan
+    def _scan_function(self, ctx: FileContext, fn: ast.AST,
+                       prefixes: Set[str],
+                       findings: List[Finding]) -> None:
+        reported: Set[int] = set()
+
+        def consume_fn(call: ast.Call) -> Optional[str]:
+            """The consumed key symbol for a jax.random call, else None."""
+            d = dotted_name(call.func)
+            if d is None or "." not in d:
+                return None
+            prefix, _, attr = d.rpartition(".")
+            if prefix not in prefixes or attr in _CREATORS:
+                return None
+            if not call.args:
+                return None
+            return dotted_name(call.args[0])
+
+        def uses_in(node: ast.AST) -> List[Tuple[str, int]]:
+            out = []
+            for sub in _scoped_walk(node):
+                if isinstance(sub, ast.Call):
+                    sym = consume_fn(sub)
+                    if sym is not None:
+                        out.append((sym, sub.lineno))
+            return out
+
+        def targets_in(node: ast.AST) -> List[str]:
+            out = []
+            for sub in _scoped_walk(node):
+                if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                        getattr(sub, "ctx", None), ast.Store):
+                    sym = dotted_name(sub)
+                    if sym is not None:
+                        out.append(sym)
+            return out
+
+        def run(stmts, state: Dict[str, int]) -> Dict[str, int]:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, ast.If):
+                    for sym, line in uses_in(stmt.test):
+                        note(sym, line, state)
+                    s_body = run(stmt.body, dict(state))
+                    s_else = run(stmt.orelse, dict(state))
+                    # a branch that terminates (return/raise/...) never
+                    # reaches the code after the If — dispatch chains like
+                    # ``if name == "uniform": return jax.random.uniform(key)``
+                    # must not mark ``key`` consumed for later branches
+                    merged = dict(state)
+                    if not _terminates(stmt.body):
+                        merged.update(s_body)
+                    if stmt.orelse and not _terminates(stmt.orelse):
+                        merged.update(s_else)
+                    state = merged
+                    continue
+                if isinstance(stmt, (ast.For, ast.While)):
+                    header = (stmt.iter if isinstance(stmt, ast.For)
+                              else stmt.test)
+                    for sym, line in uses_in(header):
+                        note(sym, line, state)
+                    if isinstance(stmt, ast.For):
+                        for sym in targets_in(stmt.target):
+                            state.pop(sym, None)
+                    state = run(stmt.body, state)
+                    state = run(stmt.body, state)   # simulated 2nd iteration
+                    state = run(stmt.orelse, state)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    state = run(stmt.body, state)
+                    for h in stmt.handlers:
+                        state = run(h.body, dict(state))
+                    state = run(stmt.orelse, state)
+                    state = run(stmt.finalbody, state)
+                    continue
+                if isinstance(stmt, ast.With):
+                    for item in stmt.items:
+                        for sym, line in uses_in(item.context_expr):
+                            note(sym, line, state)
+                    state = run(stmt.body, state)
+                    continue
+                # plain statement: uses first, then (re)bindings clear
+                for sym, line in uses_in(stmt):
+                    note(sym, line, state)
+                for sym in targets_in(stmt):
+                    state.pop(sym, None)
+            return state
+
+        def note(sym: str, line: int, state: Dict[str, int]) -> None:
+            prev = state.get(sym)
+            if prev is not None and line not in reported:
+                reported.add(line)
+                findings.append(self.finding(
+                    ctx, line,
+                    f"PRNG key {sym!r} was already consumed by a "
+                    f"jax.random call at line {prev} — split it "
+                    f"(`{sym}, sub = jax.random.split({sym})`) before "
+                    f"reusing, or the two draws are correlated"))
+            state[sym] = line if prev is None else prev
+
+        run(list(fn.body), {})

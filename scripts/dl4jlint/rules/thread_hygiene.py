@@ -1,0 +1,130 @@
+"""thread-hygiene: every ``threading.Thread`` needs a shutdown story.
+
+Two findings:
+
+- **error** — a thread created with neither ``daemon=`` nor any
+  ``.join()`` of its binding anywhere in the module: it can outlive the
+  work that spawned it and hang interpreter exit;
+- **warning** — a daemon thread bound to an instance attribute
+  (``self._thread = Thread(..., daemon=True)``) that is never joined in
+  the module: daemonising hides the leak at exit, but the owning
+  object's stop path should still join (bounded) so tests and restarts
+  don't race a half-dead worker — the PR-8 review fixed two of these by
+  hand, which is why the rule exists.
+
+Join detection is symbolic: ``x.join(...)`` marks symbol ``x`` joined,
+and a loop variable iterating a list of threads (``for t in threads:
+t.join()``, or the listcomp equivalent) marks the list symbol joined.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from scripts.dl4jlint.core import FileContext, Finding, Rule, dotted_name, \
+    WARNING
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+
+class ThreadHygieneRule(Rule):
+    name = "thread-hygiene"
+    description = ("threading.Thread without daemon= or a join/stop "
+                   "path; daemon self._thread never joined")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        joined = self._joined_symbols(ctx.nodes)
+        findings: List[Finding] = []
+        for node in ctx.nodes:
+            if not isinstance(node, ast.Assign):
+                continue
+            for call in self._thread_calls(node.value):
+                sym = self._target_symbol(node)
+                daemon = self._daemon_value(call)
+                is_joined = sym is not None and sym in joined
+                if daemon is None or daemon is False:
+                    if not is_joined:
+                        findings.append(self.finding(
+                            ctx, call.lineno,
+                            "non-daemon thread is never joined in this "
+                            "module: it can outlive its owner and hang "
+                            "interpreter exit — pass daemon=True or join "
+                            "it on the stop path"))
+                elif (sym is not None and sym.startswith("self.")
+                        and not is_joined):
+                    findings.append(self.finding(
+                        ctx, call.lineno,
+                        f"daemon thread bound to {sym} is never joined: "
+                        f"the owner's stop path should join (bounded) so "
+                        f"shutdown doesn't race a live worker",
+                        severity=WARNING))
+        # bare Thread(...).start() with no binding at all
+        for node in ctx.nodes:
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "start"):
+                inner = node.func.value
+                if (isinstance(inner, ast.Call)
+                        and dotted_name(inner.func) in _THREAD_CTORS
+                        and self._daemon_value(inner) is not True):
+                    findings.append(self.finding(
+                        ctx, node.lineno,
+                        "unbound non-daemon Thread(...).start(): nothing "
+                        "can ever join it"))
+        return findings
+
+    # -------------------------------------------------------------- helpers
+    def _thread_calls(self, value: ast.AST) -> List[ast.Call]:
+        return [n for n in ast.walk(value)
+                if isinstance(n, ast.Call)
+                and dotted_name(n.func) in _THREAD_CTORS]
+
+    @staticmethod
+    def _daemon_value(call: ast.Call) -> Optional[bool]:
+        for kw in call.keywords:
+            if kw.arg == "daemon":
+                if isinstance(kw.value, ast.Constant):
+                    return bool(kw.value.value)
+                return True   # dynamic value: assume intentional
+        return None
+
+    @staticmethod
+    def _target_symbol(assign: ast.Assign) -> Optional[str]:
+        for tgt in assign.targets:
+            if isinstance(tgt, ast.Name):
+                return tgt.id
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                return f"self.{tgt.attr}"
+        return None
+
+    def _joined_symbols(self, nodes) -> Set[str]:
+        joined: Set[str] = set()
+        aliases: Dict[str, Set[str]] = {}
+        for node in nodes:
+            if isinstance(node, ast.For) and isinstance(node.target,
+                                                        ast.Name):
+                it = dotted_name(node.iter)
+                if it is not None:
+                    aliases.setdefault(node.target.id, set()).add(it)
+            elif isinstance(node, ast.comprehension) and isinstance(
+                    node.target, ast.Name):
+                it = dotted_name(node.iter)
+                if it is not None:
+                    aliases.setdefault(node.target.id, set()).add(it)
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "join"):
+                base = node.func.value
+                sym = dotted_name(base)
+                if sym is not None:
+                    joined.add(sym)
+        # ``for t in threads: t.join()`` joins every element of ``threads``
+        # (an over-approximation when one loop variable iterates several
+        # containers — acceptable for a should-have-a-stop-path heuristic)
+        for alias, targets in aliases.items():
+            if alias in joined:
+                joined |= targets
+        return joined

@@ -65,6 +65,11 @@ def pytest_configure(config):
         "with quarantine, windowed incremental fit, SLO-gated promotion, "
         "canary, hot-swap watch + automatic rollback "
         "(python -m pytest -m online)")
+    config.addinivalue_line(
+        "markers",
+        "lint: source-level static-analysis gates — the dl4jlint rule "
+        "suite, its ratcheting baseline, and the metrics-docs/"
+        "bench-sentinel shims (python -m pytest -m lint)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,25 @@
+"""True positives for host-sync-in-hot-path (parsed, never executed)."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated_step(params, x):
+    loss = (params * x).sum()
+    return loss.item()          # sync inside a jit-traced function
+
+
+def wrapped(params, x):
+    return float(params @ x)    # traced via jax.jit(wrapped) below
+
+
+step = jax.jit(wrapped)
+
+
+def fit_loop(batches, params):
+    total = 0.0
+    for b in batches:
+        out = step(params, b)                 # hot loop: jitted step
+        total += np.asarray(out).sum()        # per-step device readback
+        out.block_until_ready()               # per-step pipeline stall
+    return total

@@ -1,0 +1,23 @@
+"""True positives for lock-discipline (parsed, never executed)."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = {}
+        self._count = 0
+
+    def activate(self, name, version):
+        with self._lock:
+            self._active[name] = version     # guarded container write
+            self._count += 1                 # guarded scalar write
+
+    def lookup(self, name):
+        return self._active.get(name)        # unlocked read of guarded map
+
+    def evict(self, name):
+        self._active.pop(name, None)         # unlocked container mutation
+
+    def size(self):
+        return self._count                   # unlocked read of guarded int

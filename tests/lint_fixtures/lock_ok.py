@@ -1,0 +1,35 @@
+"""Clean negatives for lock-discipline: consistent locking, the
+``_locked`` suffix convention, "lock held" docstrings, and a documented
+lock-free read behind a suppression."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = {}
+        self._count = 0
+
+    def activate(self, name, version):
+        with self._lock:
+            self._active[name] = version
+            self._count += 1
+
+    def lookup(self, name):
+        with self._lock:
+            return self._active.get(name)
+
+    def evict(self, name):
+        with self._lock:
+            self._evict_locked(name)
+
+    def _evict_locked(self, name):
+        self._active.pop(name, None)         # convention: caller holds lock
+
+    def _recount(self):
+        """Recompute the counter (lock held)."""
+        self._count = len(self._active)
+
+    def size(self):
+        # dl4jlint: disable-next-line=lock-discipline -- monitoring read of a GIL-atomic int
+        return self._count

@@ -1,0 +1,7 @@
+"""True positive for metrics-docs: a dl4j_* family registered with no
+help text (and no docs/observability.md row exists for it)."""
+
+
+def register(registry):
+    registry.counter("dl4j_fixture_only_total")
+    registry.counter("dl4j_fixture_only_total", "")

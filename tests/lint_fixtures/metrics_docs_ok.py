@@ -1,0 +1,8 @@
+"""Clean negative for metrics-docs: a documented family (it has a row
+in docs/observability.md) registered with non-empty help text."""
+
+_FAMILY = "dl4j_fit_step_seconds"
+
+
+def register(registry):
+    registry.histogram(_FAMILY, "Wall time of one optimisation step")

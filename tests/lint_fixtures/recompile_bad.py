@@ -1,0 +1,25 @@
+"""True positives for recompile-hazard (parsed, never executed)."""
+import jax
+
+
+def f(x):
+    return x * 2
+
+
+def immediate(x):
+    return jax.jit(f)(x)        # fresh jit invoked immediately
+
+
+def per_iteration(xs):
+    out = []
+    for x in xs:
+        g = jax.jit(f)          # fresh callable per iteration
+        out.append(g(x))
+    return out
+
+
+step = jax.jit(f)               # no static_argnums ...
+
+
+def varying_shape(batch):
+    return step(len(batch))     # ... fed a per-call Python length

@@ -1,0 +1,28 @@
+"""Clean negatives for recompile-hazard."""
+from functools import partial
+
+import jax
+
+
+def f(x):
+    return x * 2
+
+
+step = jax.jit(f)               # bound once at module level
+
+
+def run(xs):
+    return [step(x) for x in xs]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def bucketed(x, size):
+    return x[:size]
+
+
+sized = jax.jit(f, static_argnames=("n",))
+
+
+def varying_shape_declared(batch):
+    # a per-call length is FINE when the jit declared it static
+    return sized(batch, n=len(batch))

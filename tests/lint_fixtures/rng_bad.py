@@ -1,0 +1,15 @@
+"""True positives for rng-key-reuse (parsed, never executed)."""
+import jax
+
+
+def double_draw(key, shape):
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)      # same key: correlated draws
+    return a + b
+
+
+def loop_carried(key, steps):
+    outs = []
+    for _ in range(steps):
+        outs.append(jax.random.normal(key, ()))   # never split in the loop
+    return outs

@@ -1,0 +1,27 @@
+"""Clean negatives for rng-key-reuse: the split discipline, loop
+re-splitting, and the if/return dispatch shape that must NOT count as
+double consumption (only one branch ever runs)."""
+import jax
+
+
+def split_draw(key, shape):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, shape)
+    b = jax.random.uniform(k2, shape)
+    return a + b
+
+
+def loop_resplit(key, steps):
+    outs = []
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        outs.append(jax.random.normal(sub, ()))
+    return outs
+
+
+def dispatch(name, key, shape):
+    if name == "normal":
+        return jax.random.normal(key, shape)
+    if name == "uniform":
+        return jax.random.uniform(key, shape)
+    return jax.random.bernoulli(key, 0.5, shape)

@@ -1,0 +1,17 @@
+"""True positives for thread-hygiene (parsed, never executed)."""
+import threading
+
+
+def fire_and_forget(fn):
+    t = threading.Thread(target=fn)      # no daemon=, never joined
+    t.start()
+    return t
+
+
+class Server:
+    def start(self, loop):
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()             # daemon bound to self, no join
+
+    def stop(self):
+        pass                             # stop path forgets the thread

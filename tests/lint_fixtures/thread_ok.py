@@ -1,0 +1,17 @@
+"""Clean negatives for thread-hygiene."""
+import threading
+
+
+def scatter_gather(fn, n):
+    threads = [threading.Thread(target=fn, args=(i,)) for i in range(n)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]          # joined via the list alias
+
+
+class Server:
+    def start(self, loop):
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._thread.join(timeout=5.0)   # bounded join on the stop path
