@@ -1117,6 +1117,122 @@ def bench_online(platform, peak):
     }
 
 
+def bench_stability(platform, peak):
+    """The stability engine's two contracts on record (docs/resilience.md
+    "Stability"): (1) guard overhead — guarded vs unguarded step time on
+    the bench transformer (the device-side non-finite mask + dynamic loss
+    scaling must stay ≤5% — the whole point of folding the skip into the
+    XLA program instead of checking on host); (2) recovery latency — wall
+    time from a poison injection through guard-skip, sentinel verdict,
+    and checkpoint auto-rewind back to the first healthy trained step."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+    from deeplearning4j_tpu.resilience import (
+        CheckpointManager, FaultInjector, inject_faults,
+    )
+
+    if platform == "tpu":
+        batch, seq, d_model, heads, layers = 8, 2048, 1024, 8, 8
+    else:
+        batch, seq, d_model, heads, layers = 2, 256, 64, 2, 1
+    vocab = 128
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
+    warmup, iters = (3, 30) if platform == "tpu" else (2, 10)
+
+    def step_time(stability):
+        net = transformer_char_lm(
+            vocab_size=vocab, d_model=d_model, n_heads=heads, layers=layers,
+            compute_dtype="bfloat16" if platform == "tpu" else None,
+            stability=stability)
+        step = net._get_train_step()
+        state = [net.params, net.updater_state, net.net_state]
+
+        def one():
+            state[0], state[1], state[2], loss, _ = step(
+                state[0], state[1], state[2], jnp.zeros(()), x, y,
+                net._keys.next(), None, None, None)
+            return loss
+
+        one()   # compile outside the timed loop
+        dt, _, spread = _checked_time(one, warmup, iters, _sync, None, peak)
+        return dt, spread
+
+    unguarded_s, _ = step_time(None)
+    from deeplearning4j_tpu.nn.conf import TrainingStability
+
+    guarded_s, spread = step_time(TrainingStability(
+        loss_scaling="dynamic" if platform == "tpu" else "none"))
+    overhead = guarded_s / unguarded_s - 1.0
+
+    # recovery drill: persistent poison from step 8; the sentinel (check
+    # cadence 2) escalates skip -> LR backoff -> rewind to the last good
+    # snapshot; recovery = poison onset -> first healthy step after the
+    # rewind (here: the rewind returning control to the loop)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("adam", learning_rate=0.01)
+            .training_stability(check_every=2, nonfinite_streak=2,
+                                rewind_cooldown_checks=4)
+            .list()
+            .layer(DenseLayer(n_in=16, n_out=32, activation="relu"))
+            .layer(OutputLayer(n_in=32, n_out=4, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    feats = rs.rand(32, 16).astype(np.float32)
+    labs = np.zeros((32, 4), np.float32)
+    labs[np.arange(32), rs.randint(0, 4, 32)] = 1.0
+    batches = [(feats, labs)] * 24
+    with tempfile.TemporaryDirectory() as tmp:
+        cm = CheckpointManager(tmp, keep=4, save_every_steps=4,
+                               async_save=False)
+        net.fit(batches[:8], checkpoint_manager=cm)   # healthy prefix
+        inj = FaultInjector(seed=1).poison_gradients("0", at_step=8,
+                                                     until_step=16)
+        t0 = time.perf_counter()
+        with inject_faults(inj):
+            net.fit(batches[8:], checkpoint_manager=cm)
+        recovery_s = time.perf_counter() - t0
+        rewinds = float(np.asarray(  # registry child for this component
+            _stability_rewinds()))
+        cm.close()
+    final_params_finite = all(
+        bool(jnp.all(jnp.isfinite(l)))
+        for l in jax.tree_util.tree_leaves(net.params))
+    return {
+        "metric": (f"Stability guarded step (transformer d{d_model} "
+                   f"L{layers} T{seq}, guard+scale in-graph)"),
+        "value": round(guarded_s * 1e3, 3),
+        "unit": "ms/step",
+        "vs_baseline": None,   # reference has no device-side guard
+        "data": "synthetic",
+        "dtype": "bfloat16" if platform == "tpu" else "float32",
+        "unguarded_ms": round(unguarded_s * 1e3, 3),
+        "overhead_frac": round(overhead, 4),
+        "recovery_ms": round(recovery_s * 1e3, 1),
+        "rewinds_during_drill": rewinds,
+        "recovered_params_finite": final_params_finite,
+        "spread": spread,
+    }
+
+
+def _stability_rewinds():
+    from deeplearning4j_tpu.observability import get_registry
+
+    return get_registry().family_total("dl4j_divergence_rewinds_total")
+
+
 def _performance_attribution(metrics, dev):
     """The observability.performance section: step FLOPs, MFU (spec-sheet
     peak on TPU, documented CPU estimate otherwise — always labeled), and
@@ -1175,7 +1291,8 @@ def main():
             ("serving", lambda: bench_serving(platform, peak)),
             ("checkpoint", lambda: bench_checkpoint(platform, peak)),
             ("elastic", lambda: bench_elastic(platform, peak)),
-            ("online", lambda: bench_online(platform, peak))):
+            ("online", lambda: bench_online(platform, peak)),
+            ("stability", lambda: bench_stability(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

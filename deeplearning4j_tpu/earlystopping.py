@@ -135,12 +135,51 @@ class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
 
 
 class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
-    """NaN/Inf guard. ≙ ``InvalidScoreIterationTerminationCondition.java``."""
+    """NaN/Inf guard. ≙ ``InvalidScoreIterationTerminationCondition.java``.
+
+    Besides the classic last-score check, this condition watches the
+    stability engine's device-side non-finite counter
+    (``dl4j_nonfinite_steps_total``, resilience/stability.py): with the
+    step guard on, a poisoned step keeps the params finite and the lazy
+    score gauge may never be polled while NaN — the counter catches it
+    anyway.  The baseline is taken at ``initialize()`` so only
+    non-finite steps observed DURING this early-stopping run terminate
+    it.  Counter harvest happens at the engine's ``check_every``
+    boundaries (and at fit exit), so detection latency is bounded by
+    that cadence.
+
+    ``component=`` narrows the watched counter children (the family is
+    labeled per component: ``"MultiLayerNetwork"`` /
+    ``"ComputationGraph"`` / the master names) — set it when OTHER
+    stability-enabled runs share the process (an online pipeline, a side
+    model), or their skipped steps would terminate this run too.  The
+    default watches every component, which is correct for the common
+    one-training-run-per-process deployment."""
+
+    def __init__(self, component: Optional[str] = None):
+        self.component = component
+        self._baseline: Optional[float] = None
+
+    def _nonfinite_total(self) -> float:
+        from deeplearning4j_tpu.observability import get_registry
+
+        labels = {"component": self.component} if self.component else {}
+        return get_registry().family_total("dl4j_nonfinite_steps_total",
+                                           **labels)
+
+    def initialize(self) -> None:
+        self._baseline = self._nonfinite_total()
 
     def terminate(self, last_score: float) -> bool:
-        return math.isnan(last_score) or math.isinf(last_score)
+        if math.isnan(last_score) or math.isinf(last_score):
+            return True
+        base = self._baseline if self._baseline is not None else 0.0
+        return self._nonfinite_total() > base
 
     def __repr__(self):
+        if self.component:
+            return (f"InvalidScoreIterationTerminationCondition("
+                    f"component={self.component!r})")
         return "InvalidScoreIterationTerminationCondition()"
 
 

@@ -28,7 +28,7 @@ from deeplearning4j_tpu.observability import (
     crash_dump, fit_telemetry, instrument, step_guard,
 )
 from deeplearning4j_tpu.nn import losses as losses_mod
-from deeplearning4j_tpu.nn.conf import UpdaterConfig
+from deeplearning4j_tpu.nn.conf import TrainingStability, UpdaterConfig
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
 from deeplearning4j_tpu.nn.layers.dense import OutputLayer
@@ -78,6 +78,8 @@ class GraphConfiguration:
     optimization_algo: str = "stochastic_gradient_descent"
     num_iterations: int = 1
     compute_dtype: Optional[str] = None  # mixed precision, as MLN conf
+    # training-stability engine (nn.conf.TrainingStability), as MLN conf
+    stability: Optional[Any] = None
 
     def topological_order(self) -> List[str]:
         """Kahn's algorithm over the DAG (reference
@@ -144,6 +146,8 @@ class GraphConfiguration:
                 "optimization_algo": self.optimization_algo,
                 "num_iterations": self.num_iterations,
                 "compute_dtype": self.compute_dtype,
+                "stability": (self.stability.to_dict()
+                              if self.stability else None),
             },
             indent=2,
         )
@@ -164,6 +168,8 @@ class GraphConfiguration:
             optimization_algo=d.get("optimization_algo", "stochastic_gradient_descent"),
             num_iterations=d.get("num_iterations", 1),
             compute_dtype=d.get("compute_dtype"),
+            stability=(TrainingStability.from_dict(d["stability"])
+                       if d.get("stability") else None),
         )
 
 
@@ -235,6 +241,7 @@ class GraphBuilder:
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
+            stability=p._stability,
         )
         conf.validate()
         # shape inference pass: complete layers with n_in from input types
@@ -291,6 +298,7 @@ class ComputationGraph(LazyScoreMixin):
         self._score = None  # lazy score_value (LazyScoreMixin)
         self._keys = KeyStream(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
+        self._stab_rt = None   # StabilityRuntime, created on first fit
         # output-layer nodes in declared output order
         self.output_nodes = [self.nodes[o] for o in conf.outputs]
         # streaming rnnTimeStep state: node name -> carry; _stream_pos is
@@ -322,6 +330,13 @@ class ComputationGraph(LazyScoreMixin):
         self.updater_state = upd.init_state(
             self.conf.updater, {k: v for k, v in params.items() if v}
         )
+        if self.conf.stability is not None:
+            from deeplearning4j_tpu.resilience import stability
+
+            # guard/scale state rides in the updater-state pytree: it
+            # stacks, shards, donates, and checkpoints like Adam moments
+            self.updater_state[stability.STATE_KEY] = (
+                stability.initial_state(self.conf.stability))
         return self
 
     def num_params(self) -> int:
@@ -460,17 +475,40 @@ class ComputationGraph(LazyScoreMixin):
             if n.layer is not None and n.layer.learning_rate is not None
         }
 
+        policy = self.conf.stability
+
         def step(params, upd_state, net_state, iteration, inputs, labels,
                  rng, fmask, lmask, carries):
-            (loss, (new_ns, new_carries)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
+            if policy is None:
+                (loss, (new_ns, new_carries)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True
+                )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
+                grads = {k: v for k, v in grads.items() if v}
+                updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                             lr_overrides, params=params)
+                new_params = dict(params)
+                for lname, u in updates.items():
+                    new_params[lname] = upd.apply_updates(params[lname], u)
+                return new_params, new_us, new_ns, loss, new_carries
+            # non-finite step guard + loss scaling: a poisoned step folds
+            # into a device-side no-op (resilience/stability.py; same
+            # structure as MultiLayerNetwork._step_core)
+            from deeplearning4j_tpu.resilience import stability
+
+            stab, inner = stability.split_state(upd_state)
+            (_, (loss, (new_ns, new_carries))), grads = jax.value_and_grad(
+                stability.scaled_loss(self._loss_fn, stab), has_aux=True
             )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
-            grads = {k: v for k, v in grads.items() if v}
-            updates, new_us = upd.update(cfg, grads, upd_state, iteration,
-                                         lr_overrides, params=params)
-            new_params = dict(params)
-            for lname, u in updates.items():
-                new_params[lname] = upd.apply_updates(params[lname], u)
+            new_params, new_us, new_ns, finite = (
+                stability.apply_guarded_update(
+                    policy, cfg, stab, inner, params, net_state,
+                    loss, grads, new_ns, iteration, lr_overrides))
+            if new_carries is not None and policy.skip_nonfinite:
+                # poisoned TBPTT window: reset the recurrent stream state
+                # rather than carrying NaN into the next window
+                new_carries = stability.select(
+                    finite, new_carries,
+                    jax.tree_util.tree_map(jnp.zeros_like, new_carries))
             return new_params, new_us, new_ns, loss, new_carries
 
         return step
@@ -609,6 +647,18 @@ class ComputationGraph(LazyScoreMixin):
 
             res = FitResilience("ComputationGraph", checkpoint_manager,
                                 retry_policy, net=self)
+        if self.conf.stability is not None:
+            from deeplearning4j_tpu.resilience import stability
+
+            stability.ensure_state(self)
+            created = self._stab_rt is None
+            if created:
+                self._stab_rt = stability.StabilityRuntime(
+                    "ComputationGraph", self.conf.stability)
+            if created or (res is not None and res.resumed_from is not None):
+                # a restored nonfinite_total is history, not fresh evidence
+                self._stab_rt.baseline_from(
+                    self.updater_state.get(stability.STATE_KEY))
         from deeplearning4j_tpu.resilience import preemption_requested
 
         try:
@@ -625,6 +675,8 @@ class ComputationGraph(LazyScoreMixin):
                 self._fit_one(data, labels, fmask, lmask, res)
                 if res is not None:
                     res.after_step(self)
+                if self._stab_rt is not None:
+                    self._stab_rt.poll_net(self, res)
                 return self
             for batch in data:
                 if hasattr(batch, "features_masks"):  # MultiDataSet
@@ -645,12 +697,21 @@ class ComputationGraph(LazyScoreMixin):
                 self._fit_one(x, y, fm, lm, res)
                 if res is not None:
                     res.after_step(self)
+                if self._stab_rt is not None:
+                    # sentinel boundary: no-op except every check_every-th
+                    # batch (harvest + possible backoff/rewind escalation)
+                    self._stab_rt.poll_net(self, res)
         except Exception as e:
             # fit-loop exception: leave the same flight-recorder report a
             # hang would (events + live spans + registry snapshot)
             crash_dump("fit_exception", model="ComputationGraph",
                        iteration=self.iteration, error=repr(e))
             raise
+        finally:
+            if self._stab_rt is not None:
+                # final harvest: the tail past the last check boundary
+                # still lands in the non-finite counter
+                self._stab_rt.flush(self)
         return self
 
     def _unpack_multi(self, mds):
@@ -709,6 +770,13 @@ class ComputationGraph(LazyScoreMixin):
             self._one_step(x, y, fm, lm, carries=None)
 
     def _one_step(self, x, y, fm, lm, carries):
+        from deeplearning4j_tpu.resilience import get_fault_injector
+
+        inj = get_fault_injector()
+        if inj is not None and inj.has_poison():
+            # deterministic chaos: single-device fit loops poison under
+            # worker id "0" (docs/resilience.md "Stability")
+            x, y = inj.poison_batch("0", self.iteration, x, y)
         step = self._get_train_step()
         x = jax.tree_util.tree_map(jnp.asarray, self._as_input_dict(x))
         y = jax.tree_util.tree_map(jnp.asarray, self._as_label_dict(y))

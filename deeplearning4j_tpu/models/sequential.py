@@ -51,6 +51,7 @@ class MultiLayerNetwork(LazyScoreMixin):
         self._score = None  # lazy score_value (LazyScoreMixin)
         self._keys = KeyStream(conf.seed)
         self._jit_cache: Dict[Any, Any] = {}
+        self._stab_rt = None   # StabilityRuntime, created on first fit
         # streaming rnnTimeStep state: layer_name -> carry; _stream_pos is
         # the host-side mirror of the caches' device position scalar
         self._rnn_state: Dict[str, Any] = {}
@@ -72,6 +73,13 @@ class MultiLayerNetwork(LazyScoreMixin):
         self.params = params
         self.net_state = net_state
         self.updater_state = upd.init_state(self.conf.updater, self._trainable(params))
+        if self.conf.stability is not None:
+            from deeplearning4j_tpu.resilience import stability
+
+            # guard/scale state rides in the updater-state pytree: it
+            # stacks, shards, donates, and checkpoints like Adam moments
+            self.updater_state[stability.STATE_KEY] = (
+                stability.initial_state(self.conf.stability))
         return self
 
     def _trainable(self, params):
@@ -187,24 +195,51 @@ class MultiLayerNetwork(LazyScoreMixin):
     # ------------------------------------------------------------ train step
     def _step_core(self):
         """The raw (un-jitted) SGD step shared by the per-batch train step
-        and the scanned multi-step window."""
+        and the scanned multi-step window.  With ``conf.stability`` set,
+        the step is wrapped by the non-finite guard: the loss is scaled
+        before ``grad`` (mixed-precision loss scaling), gradients are
+        unscaled and checked all-finite, and a poisoned step folds into a
+        device-side no-op (``params = where(finite, new, old)``; updater
+        and net state likewise) — zero host syncs, zero recompiles
+        (resilience/stability.py).  ``stability=None`` keeps the exact
+        pre-guard trace."""
         updater_cfg = self.conf.updater
+        policy = self.conf.stability
         lr_overrides = {
             l.name: l.learning_rate for l in self.layers if l.learning_rate is not None
         }
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fmask, lmask, carries):
-            (loss, (new_net_state, new_carries)), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True
-            )(params, net_state, x, y, rng, fmask, lmask, carries)
-            grads = {k: v for k, v in grads.items() if v}
-            updates, new_upd_state = upd.update(
-                updater_cfg, grads, upd_state, iteration, lr_overrides,
-                params=params,
-            )
-            new_params = dict(params)
-            for lname, u in updates.items():
-                new_params[lname] = upd.apply_updates(params[lname], u)
+            if policy is None:
+                (loss, (new_net_state, new_carries)), grads = jax.value_and_grad(
+                    self._loss_fn, has_aux=True
+                )(params, net_state, x, y, rng, fmask, lmask, carries)
+                grads = {k: v for k, v in grads.items() if v}
+                updates, new_upd_state = upd.update(
+                    updater_cfg, grads, upd_state, iteration, lr_overrides,
+                    params=params,
+                )
+                new_params = dict(params)
+                for lname, u in updates.items():
+                    new_params[lname] = upd.apply_updates(params[lname], u)
+                return new_params, new_upd_state, new_net_state, loss, new_carries
+            from deeplearning4j_tpu.resilience import stability
+
+            stab, inner = stability.split_state(upd_state)
+            (_, (loss, (new_net_state, new_carries))), grads = (
+                jax.value_and_grad(
+                    stability.scaled_loss(self._loss_fn, stab), has_aux=True
+                )(params, net_state, x, y, rng, fmask, lmask, carries))
+            new_params, new_upd_state, new_net_state, finite = (
+                stability.apply_guarded_update(
+                    policy, updater_cfg, stab, inner, params, net_state,
+                    loss, grads, new_net_state, iteration, lr_overrides))
+            if new_carries is not None and policy.skip_nonfinite:
+                # a poisoned TBPTT window must not smuggle NaN hidden
+                # state into the next window: reset the stream instead
+                new_carries = stability.select(
+                    finite, new_carries,
+                    jax.tree_util.tree_map(jnp.zeros_like, new_carries))
             return new_params, new_upd_state, new_net_state, loss, new_carries
 
         return step
@@ -346,6 +381,18 @@ class MultiLayerNetwork(LazyScoreMixin):
 
             res = FitResilience("MultiLayerNetwork", checkpoint_manager,
                                 retry_policy, net=self)
+        if self.conf.stability is not None:
+            from deeplearning4j_tpu.resilience import stability
+
+            stability.ensure_state(self)
+            created = self._stab_rt is None
+            if created:
+                self._stab_rt = stability.StabilityRuntime(
+                    "MultiLayerNetwork", self.conf.stability)
+            if created or (res is not None and res.resumed_from is not None):
+                # a restored nonfinite_total is history, not fresh evidence
+                self._stab_rt.baseline_from(
+                    self.updater_state.get(stability.STATE_KEY))
         try:
             if labels is not None:
                 batches = [(data, labels, fmask, lmask)]
@@ -360,6 +407,12 @@ class MultiLayerNetwork(LazyScoreMixin):
             crash_dump("fit_exception", model="MultiLayerNetwork",
                        iteration=self.iteration, error=repr(e))
             raise
+        finally:
+            if self._stab_rt is not None:
+                # final harvest: the tail of the run past the last check
+                # boundary still lands in the non-finite counter (early
+                # stopping and health rules read it)
+                self._stab_rt.flush(self)
         return self
 
     def _fit_batches(self, batches, res=None) -> bool:
@@ -412,6 +465,11 @@ class MultiLayerNetwork(LazyScoreMixin):
                     self._one_step(step, x, y, fm, lm, carries=None)
             if res is not None:
                 res.after_step(self)
+            if self._stab_rt is not None:
+                # divergence sentinel: no-op except every check_every-th
+                # boundary, where the device counter is harvested and an
+                # escalation (LR backoff / checkpoint rewind) may land
+                self._stab_rt.poll_net(self, res)
         return False
 
     def _fit_solver(self, x, y, fm, lm):
@@ -435,6 +493,13 @@ class MultiLayerNetwork(LazyScoreMixin):
         )
 
     def _one_step(self, step, x, y, fm, lm, carries):
+        from deeplearning4j_tpu.resilience import get_fault_injector
+
+        inj = get_fault_injector()
+        if inj is not None and inj.has_poison():
+            # deterministic chaos: single-device fit loops poison under
+            # worker id "0" (docs/resilience.md "Stability")
+            x, y = inj.poison_batch("0", self.iteration, x, y)
         rng = self._keys.next()
         it = jnp.asarray(self.iteration, jnp.float32)
         tel = fit_telemetry("MultiLayerNetwork")

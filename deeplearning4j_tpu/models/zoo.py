@@ -340,7 +340,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         rope: bool = True,
                         n_kv_heads: Optional[int] = None,
                         window: Optional[int] = None,
-                        max_cache: int = 1024) -> MultiLayerNetwork:
+                        max_cache: int = 1024,
+                        stability=None) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
@@ -360,12 +361,16 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
         EmbeddingLayer, LayerNorm, ResidualBlock, SelfAttentionLayer,
     )
 
-    b = (
+    nb = (
         NeuralNetConfiguration.builder()
         .seed(seed)
         .updater(updater, learning_rate=lr)
-        .list()
     )
+    if stability is not None:
+        # training-stability engine (nn.conf.TrainingStability): the
+        # non-finite guard + loss scaling the production loops run with
+        nb.training_stability(stability)
+    b = nb.list()
     if compute_dtype:
         b.compute_dtype(compute_dtype)
     # collapse_column off: ids are [B, T] sequences; a length-1 prompt must

@@ -71,6 +71,70 @@ class UpdaterConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TrainingStability:
+    """Training-stability policy (engine: ``resilience/stability.py``).
+
+    The policy is pure configuration — serialized with the network config
+    so a checkpointed run resumes with the same guard semantics.  The
+    reference's closest analogs are ``GradientNormalization`` (bounded
+    updates) and ``InvalidScoreIterationTerminationCondition`` (die on
+    NaN); this subsumes both with a device-side non-finite step guard
+    (a poisoned step becomes a no-op, no host sync), optional dynamic
+    loss scaling for low-precision compute, and a host-side divergence
+    sentinel that escalates skip -> LR backoff -> checkpoint rewind.
+
+    ``loss_scaling``: ``"none"`` | ``"dynamic"`` (grow-on-streak /
+    halve-on-overflow, state carried in the jitted step and
+    checkpointed) | ``"static"`` (fixed ``loss_scale``).
+    ``check_every``: fit-loop boundaries between sentinel polls — the
+    only host syncs the engine performs happen at these boundaries, so
+    the per-step hot path stays sync-free.  ``nonfinite_streak``:
+    non-finite steps within one poll window that count as sustained
+    divergence.  ``spike_factor`` / ``spike_patience``: finite-loss
+    spike detection vs the rolling healthy baseline.  ``lr_backoff``:
+    multiplier applied to the (device-carried) LR scale on escalation.
+    ``poison_evict_after``: poisoned averaging windows before a replica
+    is handed to the ElasticController as a ``"poisoned"`` eviction.
+    """
+
+    skip_nonfinite: bool = True
+    loss_scaling: str = "none"          # none | dynamic | static
+    loss_scale: float = 2.0 ** 15
+    loss_scale_factor: float = 2.0
+    loss_scale_growth_interval: int = 200
+    loss_scale_min: float = 1.0
+    loss_scale_max: float = 2.0 ** 24
+    check_every: int = 25
+    spike_factor: float = 10.0
+    spike_patience: int = 2
+    nonfinite_streak: int = 4
+    lr_backoff: float = 0.5
+    rewind_cooldown_checks: int = 2
+    poison_evict_after: int = 2
+
+    def __post_init__(self):
+        if self.loss_scaling not in ("none", "dynamic", "static"):
+            raise ValueError(
+                f"unsupported loss_scaling '{self.loss_scaling}' "
+                "(use 'none', 'dynamic', or 'static')")
+        if self.loss_scale <= 0 or self.loss_scale_min <= 0:
+            raise ValueError("loss scales must be > 0")
+        if self.loss_scale_factor <= 1.0:
+            raise ValueError("loss_scale_factor must be > 1")
+        if self.check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        if not 0.0 < self.lr_backoff < 1.0:
+            raise ValueError("lr_backoff must be in (0, 1)")
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return TrainingStability(**d)
+
+
+@dataclasses.dataclass(frozen=True)
 class MultiLayerConfiguration:
     """Completed, immutable network config (reference
     ``nn/conf/MultiLayerConfiguration.java``)."""
@@ -91,6 +155,9 @@ class MultiLayerConfiguration:
     # loss and updater math stay float32 (MXU-native policy; no reference
     # analog — ND4J is float-global)
     compute_dtype: Optional[str] = None
+    # training-stability engine (non-finite step guard, loss scaling,
+    # divergence sentinel) — None keeps the exact pre-stability trace
+    stability: Optional[TrainingStability] = None
 
     def __post_init__(self):
         # guard every construction path (builder, from_dict, direct): an
@@ -117,6 +184,7 @@ class MultiLayerConfiguration:
             "pretrain": self.pretrain,
             "backprop": self.backprop,
             "compute_dtype": self.compute_dtype,
+            "stability": self.stability.to_dict() if self.stability else None,
         }
 
     def to_json(self) -> str:
@@ -138,6 +206,8 @@ class MultiLayerConfiguration:
             pretrain=d.get("pretrain", False),
             backprop=d.get("backprop", True),
             compute_dtype=d.get("compute_dtype"),
+            stability=(TrainingStability.from_dict(d["stability"])
+                       if d.get("stability") else None),
         )
 
     @staticmethod
@@ -251,6 +321,7 @@ class ListBuilder:
             pretrain=self._pretrain,
             backprop=self._backprop,
             compute_dtype=self._compute_dtype,
+            stability=p._stability,
         )
 
 
@@ -271,6 +342,7 @@ class Builder:
         self._l2: Optional[float] = None
         self._dropout: Optional[float] = None
         self._regularization = False
+        self._stability: Optional[TrainingStability] = None
 
     def seed(self, s: int) -> "Builder":
         self._seed = int(s)
@@ -306,6 +378,30 @@ class Builder:
             gradient_normalization=kind,
             gradient_normalization_threshold=threshold,
         )
+        return self
+
+    def training_stability(self, policy=True, **kwargs) -> "Builder":
+        """Enable the training-stability engine (device-side non-finite
+        step guard, optional loss scaling, divergence sentinel — see
+        ``TrainingStability`` / docs/resilience.md "Stability").  Pass a
+        ``TrainingStability``, keyword overrides, or ``False`` to
+        disable::
+
+            .training_stability(loss_scaling="dynamic", check_every=10)
+        """
+        if policy is False or policy is None:
+            if kwargs:
+                raise ValueError("training_stability(False) takes no kwargs")
+            self._stability = None
+        elif isinstance(policy, TrainingStability):
+            self._stability = (dataclasses.replace(policy, **kwargs)
+                               if kwargs else policy)
+        elif policy is True:
+            self._stability = TrainingStability(**kwargs)
+        else:
+            raise ValueError(
+                f"training_stability expects True/False/TrainingStability, "
+                f"got {policy!r}")
         return self
 
     def optimization_algo(self, algo: str) -> "Builder":

@@ -378,6 +378,15 @@ class HealthRule:
       degraded-mode mesh (replicas evicted from the averaging collective,
       docs/resilience.md "Elasticity") is tolerable up to a budget —
       beyond it the run is limping and /health should say so
+    - ``max_nonfinite_steps`` — summed counter
+      (``dl4j_nonfinite_steps_total``) must be <= ``limit``: the
+      stability engine's guard turns poisoned steps into no-ops, but a
+      run skipping many steps is limping — budget it
+      (docs/resilience.md "Stability")
+    - ``max_divergence_rewinds`` — summed counter
+      (``dl4j_divergence_rewinds_total``) must be <= ``limit``: every
+      auto-rewind re-trains from an older checkpoint; repeated rewinds
+      mean the run cannot make it past a divergence wall
     - ``predicate`` — ``fn(extra) -> bool`` (or ``(ok, observed, detail)``)
       for liveness checks that live outside the registry
 
@@ -394,6 +403,8 @@ class HealthRule:
         "max_stragglers": "dl4j_stragglers_total",
         "max_checkpoint_staleness": "dl4j_checkpoint_staleness_seconds",
         "max_evicted_replicas": "dl4j_elastic_evicted_replicas",
+        "max_nonfinite_steps": "dl4j_nonfinite_steps_total",
+        "max_divergence_rewinds": "dl4j_divergence_rewinds_total",
     }
 
     def __init__(self, name: str, kind: str, limit: Optional[float] = None,
@@ -538,15 +549,19 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
                            max_stragglers: Optional[float] = None,
                            max_checkpoint_staleness_s: Optional[float] = None,
                            max_evicted_replicas: Optional[float] = None,
+                           max_nonfinite_steps: Optional[float] = None,
+                           max_divergence_rewinds: Optional[float] = None,
                            ) -> List[HealthRule]:
     """Sensible defaults for a training process: an optional step-time
     SLO, an optional throughput floor, a recompile budget (steady-state
     shape churn is the classic silent TPU throughput bug), an optional
     straggler budget, an optional checkpoint-staleness cap (a run whose
     CheckpointManager stopped committing fails /health while the progress
-    is still recoverable — docs/resilience.md), and an optional evicted-
+    is still recoverable — docs/resilience.md), an optional evicted-
     replica budget (degraded-mode training past the budget fails /health
-    even though the loop is still making progress)."""
+    even though the loop is still making progress), and optional
+    stability budgets: guarded-skip steps and divergence auto-rewinds
+    (docs/resilience.md "Stability")."""
     rules = [HealthRule("recompile_budget", "max_recompiles",
                         max_recompiles)]
     if max_step_p99_s is not None:
@@ -564,6 +579,13 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
     if max_evicted_replicas is not None:
         rules.append(HealthRule("evicted_replicas", "max_evicted_replicas",
                                 max_evicted_replicas))
+    if max_nonfinite_steps is not None:
+        rules.append(HealthRule("nonfinite_steps", "max_nonfinite_steps",
+                                max_nonfinite_steps))
+    if max_divergence_rewinds is not None:
+        rules.append(HealthRule("divergence_rewinds",
+                                "max_divergence_rewinds",
+                                max_divergence_rewinds))
     return rules
 
 

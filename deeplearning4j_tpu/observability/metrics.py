@@ -389,6 +389,22 @@ class MetricsRegistry:
             return None
         return child.value if not isinstance(child, Histogram) else None
 
+    def family_total(self, name: str, **labels) -> float:
+        """Sum of a counter/gauge family's children matching ``labels``
+        (0.0 when the family is absent) — the read used by summed-counter
+        consumers (early-stopping's non-finite guard, bench snapshots)."""
+        fam = self.get(name)
+        if fam is None:
+            return 0.0
+        total = 0.0
+        for label_pairs, child in fam.samples():
+            if isinstance(child, Histogram):
+                continue
+            d = dict(label_pairs)
+            if all(d.get(k) == v for k, v in labels.items()):
+                total += child.value
+        return total
+
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         for fam in self.families():

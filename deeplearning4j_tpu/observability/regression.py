@@ -100,6 +100,15 @@ DEFAULT_RULES: List[Rule] = [
          tolerance=1.0),
     Rule("Online stream-to-serving freshness", field="promoted",
          tolerance=0.0, required=False),
+    # stability engine (bench_stability): the guarded train step must not
+    # drift slower — the device-side non-finite mask + loss scaling ride
+    # inside the XLA program, so a step-time collapse here means the
+    # guard fell off the fused path.  Recovery = poison onset -> guard
+    # skips -> sentinel verdict -> checkpoint rewind -> training resumed;
+    # wide tolerance because the drill includes checkpoint I/O.
+    Rule("Stability guarded step", direction=LOWER, tolerance=0.4),
+    Rule("Stability guarded step", field="recovery_ms", direction=LOWER,
+         tolerance=1.0, required=False),
 ]
 
 

@@ -195,6 +195,7 @@ class ParallelWrapper:
         # a fresh wrapper per epoch around one persistent controller).
         self._elastic: Optional[ElasticController] = None
         self._ones_w: Optional[np.ndarray] = None
+        self._stab_rt = None   # StabilityRuntime (net.conf.stability)
         if isinstance(elastic, ElasticController):
             if elastic.K != self.workers:
                 raise ValueError(
@@ -223,6 +224,7 @@ class ParallelWrapper:
     def _build(self):
         net = self.net
         cfg = net.conf.updater
+        policy = net.conf.stability
         lr_overrides = {
             l.name: l.learning_rate for l in net.layers if l.learning_rate is not None
         }
@@ -230,16 +232,31 @@ class ParallelWrapper:
         average_updaters = self.average_updaters
 
         def one_replica_step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
-            (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
-                params, net_state, x, y, rng, fm, lm, None
-            )
-            grads = {k: v for k, v in grads.items() if v}
-            updates, new_us = upd.update(cfg, grads, upd_state, iteration,
-                                         lr_overrides, params=params)
-            new_params = dict(params)
-            for lname, u in updates.items():
-                new_params[lname] = upd.apply_updates(params[lname], u)
-            return new_params, new_us, new_ns, loss
+            if policy is None:
+                (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
+                    params, net_state, x, y, rng, fm, lm, None
+                )
+                grads = {k: v for k, v in grads.items() if v}
+                updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                             lr_overrides, params=params)
+                new_params = dict(params)
+                for lname, u in updates.items():
+                    new_params[lname] = upd.apply_updates(params[lname], u)
+                return new_params, new_us, new_ns, loss, jnp.ones(())
+            # non-finite step guard per replica (resilience/stability.py):
+            # a poisoned replica's step is a device-side no-op; the window
+            # averaging below ALSO weights it out of the collective
+            from deeplearning4j_tpu.resilience import stability
+
+            stab, inner = stability.split_state(upd_state)
+            (_, (loss, (new_ns, _))), grads = jax.value_and_grad(
+                stability.scaled_loss(net._loss_fn, stab), has_aux=True)(
+                params, net_state, x, y, rng, fm, lm, None)
+            new_params, new_us, new_ns, finite = (
+                stability.apply_guarded_update(
+                    policy, cfg, stab, inner, params, net_state,
+                    loss, grads, new_ns, iteration, lr_overrides))
+            return new_params, new_us, new_ns, loss, finite.astype(jnp.float32)
 
         vstep = jax.vmap(one_replica_step, in_axes=(0, 0, 0, None, 0, 0, 0, 0, 0))
 
@@ -251,17 +268,29 @@ class ParallelWrapper:
             1 otherwise.  The average is renormalized over the weighted
             set and broadcast into ALL K slots, so an evicted replica's
             slot always holds the current healthy average (that broadcast
-            IS the re-admission catch-up)."""
+            IS the re-admission catch-up).  With the stability engine on,
+            a replica with ANY non-finite step this window is additionally
+            weighted out (poison masking — same zero-recompile mask), and
+            the window reports [K] poison flags + a non-finite step count."""
 
             def body(carry, inp):
                 p, u, n, it = carry
                 x, y, rng, fm, lm = inp
-                p, u, n, loss = vstep(p, u, n, it, x, y, rng, fm, lm)
-                return (p, u, n, it + 1.0), loss
+                p, u, n, loss, fin = vstep(p, u, n, it, x, y, rng, fm, lm)
+                return (p, u, n, it + 1.0), (loss, fin)
 
-            (params_k, upd_k, ns_k, _), losses = jax.lax.scan(
+            (params_k, upd_k, ns_k, _), (losses, finites) = jax.lax.scan(
                 body, (params_k, upd_k, ns_k, iteration), (xs, ys, rngs, fms, lms)
             )
+            if policy is not None:
+                # [K] 1 where every step of the window was finite
+                win_finite = jnp.min(finites, axis=0)
+                w_eff = weights * win_finite
+                # all real replicas poisoned: fall back to the original
+                # weights — every per-replica update was already skipped
+                # device-side, so the average stays finite either way
+                safe = jnp.sum(w_eff) > 0
+                weights = jnp.where(safe, w_eff, weights)
             # parameter averaging: weighted all-reduce over the replica
             # axis then re-broadcast (reference averageAndPropagate
             # semantics, renormalized over the healthy/unpadded set —
@@ -278,6 +307,9 @@ class ParallelWrapper:
             ns_k = jax.tree_util.tree_map(wavg, ns_k)
             if average_updaters:
                 upd_k = jax.tree_util.tree_map(wavg, upd_k)
+            if policy is not None:
+                return (params_k, upd_k, ns_k, losses,
+                        1.0 - win_finite, jnp.sum(1.0 - finites))
             return params_k, upd_k, ns_k, losses
 
         self._step_fn = instrument(
@@ -299,7 +331,7 @@ class ParallelWrapper:
             AsyncDataSetIterator, DataSetIterator, ListDataSetIterator,
         )
         from deeplearning4j_tpu.resilience import (
-            FitResilience, preemption_requested,
+            FitResilience, get_fault_injector, preemption_requested,
         )
 
         if self._step_fn is None:
@@ -313,6 +345,18 @@ class ParallelWrapper:
             res = FitResilience("parallel_wrapper", self.checkpoint_manager,
                                 self.retry_policy, net=net, mesh=self.mesh)
         K, F = self.workers, self.averaging_frequency
+        policy = net.conf.stability
+        if policy is not None:
+            from deeplearning4j_tpu.resilience import stability
+
+            # stability state must exist BEFORE replica stacking so the
+            # per-replica guard/scale scalars ride in upd_k
+            stability.ensure_state(net)
+            if self._stab_rt is None:
+                self._stab_rt = stability.StabilityRuntime(
+                    "parallel_wrapper", policy,
+                    worker_ids=[str(k) for k in range(K)])
+        stab_rt = self._stab_rt
         params_k = _stack_tree(net.params, K)
         upd_k = _stack_tree(net.updater_state, K)
         ns_k = _stack_tree(net.net_state, K)
@@ -368,6 +412,11 @@ class ParallelWrapper:
                 self.iteration = it - it0
                 return net
             weights = self._window_weights(it, pad_w)
+            inj = get_fault_injector()
+            if inj is not None and inj.has_poison():
+                # deterministic chaos: replica k's slot is xs[:, k]
+                xs = inj.poison_replica_slots(
+                    [str(k) for k in range(K)], it, xs)
             t_disp0 = time.perf_counter()
             with step_guard("parallel_window",
                             component="parallel_wrapper", iteration=it):
@@ -384,10 +433,16 @@ class ParallelWrapper:
                             jnp.asarray(weights))
 
                     if res is not None:
-                        params_k, upd_k, ns_k, last_losses = res.step(
-                            dispatch, it, net=net)
+                        out = res.step(dispatch, it, net=net)
                     else:
-                        params_k, upd_k, ns_k, last_losses = dispatch()
+                        out = dispatch()
+                    if stab_rt is not None:
+                        (params_k, upd_k, ns_k, last_losses,
+                         poison_k, nf_ct) = out
+                        # device-side adds only; read at check boundaries
+                        stab_rt.accumulate(nf_ct, poison_k)
+                    else:
+                        params_k, upd_k, ns_k, last_losses = out
                 if self.collect_worker_stats:
                     self._publish_worker_stats(
                         last_losses, time.perf_counter() - t_disp0,
@@ -399,6 +454,32 @@ class ParallelWrapper:
                 # delay; degraded mode's win is the stall it stops paying
                 self._elastic.window_barrier(it)
             it += adv
+            if stab_rt is not None:
+                from deeplearning4j_tpu.resilience import stability
+
+                action = stab_rt.poll_master(
+                    step=it, losses=last_losses, elastic=self._elastic,
+                    # stacked [K] scale state: feeds the loss-scale /
+                    # lr-scale gauges at check boundaries (nonfinite
+                    # totals still come from the window accumulator)
+                    stab_state=upd_k.get(stability.STATE_KEY),
+                    can_rewind=res is not None and res.cm is not None)
+                if action == "backoff":
+                    upd_k = stability.apply_lr_backoff_tree(upd_k, policy)
+                elif action == "rewind":
+                    self._fold_back(net, params_k, upd_k, ns_k, it,
+                                    last_losses)
+                    if stab_rt.rewind(net, res.cm) is not None:
+                        # restage the rewound facade state onto the mesh
+                        it = net.iteration
+                        params_k = jax.device_put(
+                            _stack_tree(net.params, K), shard)
+                        upd_k = _stack_tree(net.updater_state, K)
+                        if net.updater_state:
+                            upd_k = jax.device_put(upd_k, shard)
+                        ns_k = _stack_tree(net.net_state, K)
+                        if net.net_state:
+                            ns_k = jax.device_put(ns_k, shard)
             self._phases.steps += 1
             if res is not None and res.cm is not None:
                 trigger = res.cm.due(it)
@@ -410,6 +491,8 @@ class ParallelWrapper:
                     res.cm.save(net, trigger=trigger)
 
         self._fold_back(net, params_k, upd_k, ns_k, it, last_losses)
+        if stab_rt is not None:
+            stab_rt.flush(net)   # tail past the last check boundary
         self.iteration = it - it0
         return net
 

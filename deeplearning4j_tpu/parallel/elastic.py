@@ -144,8 +144,8 @@ class ElasticController:
             registry = get_registry()
         self._m_evictions = registry.counter(
             _EVICTIONS, "Replica evictions from the data-parallel "
-            "collective, by reason (straggler / hang / dead / manual) — "
-            "the evicted replica is named in the worker label",
+            "collective, by reason (straggler / hang / dead / poisoned / "
+            "manual) — the evicted replica is named in the worker label",
             labels=("component", "worker", "reason"))
         self._m_readmissions = registry.counter(
             _READMISSIONS, "Replica re-admissions into the collective "
@@ -301,6 +301,18 @@ class ElasticController:
             min_healthy=self.cfg.min_healthy,
             max_evicted=self._max_evicted())
 
+    def report_poisoned(self, worker, step: int) -> None:
+        """Device-side repeat-offender verdict from the stability engine
+        (``resilience/stability.py``): the named replica's gradients were
+        non-finite in ``poison_evict_after``+ averaging windows — evict
+        it with reason ``"poisoned"`` (or make the cap refusal visible).
+        Re-admission follows the straggler probation path once the fault
+        clears."""
+        worker = str(worker)
+        if not self._state[worker]["active"]:
+            return
+        self._evict_or_report(worker, "poisoned", step)
+
     def _flags(self, worker: str) -> int:
         if self.detector is None:
             return 0
@@ -309,16 +321,17 @@ class ElasticController:
 
     def _worker_fault(self, inj, worker: str, step: int) -> str:
         """Worst injected state over the slot's member devices
-        (``dead`` > ``hung`` > ``ok``)."""
+        (``dead`` > ``hung`` > ``poisoned`` > ``ok``)."""
         if inj is None:
             return "ok"
+        rank = {"ok": 0, "poisoned": 1, "hung": 2, "dead": 3}
         state = "ok"
         for a in self.aliases[worker]:
             s = inj.worker_state(a, step)
             if s == "dead":
                 return "dead"
-            if s == "hung":
-                state = "hung"
+            if rank.get(s, 0) > rank[state]:
+                state = s
         return state
 
     # ------------------------------------------------------ window protocol
@@ -342,18 +355,25 @@ class ElasticController:
                           and self._flags(w) - st["flag_base"]
                           >= self.cfg.evict_after_flags):
                         self._evict_or_report(w, "straggler", step)
-                    else:
+                    elif fault == "ok":
                         st["refused"] = None   # episode over: fault gone
+                    # fault == "poisoned": an ACTIVE poisoned replica is
+                    # handled device-side (its gradients are weighted out
+                    # of the average per window); eviction arrives via
+                    # report_poisoned once it is a repeat offender
                 else:
                     st["windows_out"] += 1
                     if fault != "ok":
                         continue       # fault still live: stay evicted
                     if st["reason"] in ("dead", "hang"):
                         self.readmit(w, step)   # fault cleared
-                    elif (st["reason"] == "straggler"
+                    elif (st["reason"] in ("straggler", "poisoned")
                           and st["windows_out"]
                           >= self.cfg.readmit_after_windows):
-                        self.readmit(w, step)   # straggler probation
+                        # probation: a straggler verdict or poison streak
+                        # may have been transient (bad data window) — the
+                        # next offense just re-evicts
+                        self.readmit(w, step)
                     # any other reason (e.g. "manual") stays evicted until
                     # an explicit readmit() — an operator decision is not
                     # a fault that clears or a verdict that expires

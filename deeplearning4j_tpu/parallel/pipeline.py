@@ -241,6 +241,29 @@ class PipelineParallelTrainingMaster(TrainingMaster):
         self.checkpoint_manager = checkpoint_manager
         self.retry_policy = retry_policy
 
+    def _warn_fast_path_downgrade(self, reasons) -> None:
+        """One-shot (per master) warning + flight event when the updater
+        config knocks this net off the sharded fast path: param placement
+        degrades from stage-per-device to fully replicated, so per-device
+        memory silently holds the WHOLE model."""
+        if getattr(self, "_downgrade_warned", False):
+            return
+        self._downgrade_warned = True
+        import warnings
+
+        from deeplearning4j_tpu.observability import get_flight_recorder
+
+        why = "; ".join(reasons)
+        warnings.warn(
+            f"pipeline master: sharded param fast path DISABLED by {why} — "
+            "params are replicated on every stage device (full-model "
+            "memory per device).  Use mode='orchestrated' for partitioned "
+            "placement, or drop the non-elementwise updater options "
+            "(docs/PARALLELISM.md).", RuntimeWarning, stacklevel=3)
+        get_flight_recorder().record(
+            "pipeline_fast_path_downgrade", component="pipeline_master",
+            reasons=reasons, n_stages=self.n_stages, mode=self.mode)
+
     def training_stats(self) -> Dict[str, Any]:
         """Phase-timed stats: whole-step ``dispatch`` on the compiled paths,
         ``stage{s}_fwd``/``stage{s}_bwd`` dispatch on the orchestrated one
@@ -296,6 +319,19 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             elementwise_updater = (
                 not lr_overrides
                 and cfg.gradient_normalization in (None, "none"))
+            if not elementwise_updater:
+                # make the downgrade LOUD: these configs silently fell off
+                # the sharded fast path onto replicated params (full model
+                # per device) with nothing in logs or flight data naming
+                # the cause (docs/PARALLELISM.md "Sharded fast path")
+                self._warn_fast_path_downgrade(
+                    ([f"gradient_normalization="
+                      f"{cfg.gradient_normalization!r}"]
+                     if cfg.gradient_normalization not in (None, "none")
+                     else [])
+                    + (["per-layer learning-rate overrides: "
+                        + ", ".join(sorted(lr_overrides))]
+                       if lr_overrides else []))
             # best path: periodic run -> stacked params SHARDED stage-per-
             # device (param memory partitioned)
             if elementwise_updater:

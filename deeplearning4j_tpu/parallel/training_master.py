@@ -131,6 +131,8 @@ class SyncTrainingMaster(TrainingMaster):
         # already pays in its device_sync phase)
         self._workers: Optional[WorkerTelemetry] = None
         self._step = None
+        self._stab_rt = None          # StabilityRuntime (net.conf.stability)
+        self._stab_workers: list = []  # data-slot worker ids ("d<id>")
 
     @property
     def elastic(self) -> Optional[ElasticController]:
@@ -179,19 +181,26 @@ class SyncTrainingMaster(TrainingMaster):
         return NamedSharding(self.mesh, P())
 
     def _build(self, net):
+        from deeplearning4j_tpu.resilience import stability
+
         cfg = net.conf.updater
+        policy = net.conf.stability
         lr_overrides = {
             l.name: l.learning_rate for l in net.layers if l.learning_rate is not None
         }
         mesh = self.mesh
+        K = mesh.shape[backend.AXIS_DATA]
         repl = NamedSharding(mesh, P())
         data = NamedSharding(mesh, P(backend.AXIS_DATA))
         players = self._param_layout(net)
         # updater state mirrors the param tree per slot ({"m": ..., "v": ...})
-        # but only over TRAINABLE layers — restrict to the state's own keys
+        # but only over TRAINABLE layers — restrict to the state's own keys.
+        # The stability subtree is plain scalars (loss scale, counters):
+        # replicated, like the rest of the non-param step state.
         if isinstance(players, dict) and net.updater_state:
             ulayers: Any = {
-                slot: {ln: players[ln] for ln in tree}
+                slot: (repl if slot == stability.STATE_KEY
+                       else {ln: players[ln] for ln in tree})
                 for slot, tree in net.updater_state.items()
             }
         elif isinstance(players, dict):
@@ -200,25 +209,57 @@ class SyncTrainingMaster(TrainingMaster):
             ulayers = players
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
-            (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
-                params, net_state, x, y, rng, fm, lm, None
-            )
-            grads = {k: v for k, v in grads.items() if v}
-            updates, new_us = upd.update(cfg, grads, upd_state, iteration,
-                                         lr_overrides, params=params)
-            new_params = {
-                ln: (upd.apply_updates(params[ln], u)
-                     if (u := updates.get(ln)) else params[ln])
-                for ln in params
-            }
-            return new_params, new_us, new_ns, loss
+            if policy is None:
+                (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
+                    params, net_state, x, y, rng, fm, lm, None
+                )
+                grads = {k: v for k, v in grads.items() if v}
+                updates, new_us = upd.update(cfg, grads, upd_state, iteration,
+                                             lr_overrides, params=params)
+                new_params = {
+                    ln: (upd.apply_updates(params[ln], u)
+                         if (u := updates.get(ln)) else params[ln])
+                    for ln in params
+                }
+                return new_params, new_us, new_ns, loss
+            # stability engine (resilience/stability.py): poisoned ROWS are
+            # zeroed before the forward (NaN activations poison the
+            # backward even under a zero cotangent) and renormalized out
+            # of the masked loss mean — the global gradient is EXACTLY the
+            # mean over the healthy rows, the sync-master analog of the
+            # wrapper's [K] weight mask.  A residual non-finite verdict
+            # (fp overflow in healthy data) still skips the whole step
+            # device-side.  The caller guarantees lm is always an array
+            # (all-ones when no mask), so poison flips values, not the
+            # pytree — zero recompiles.
+            stab, inner = stability.split_state(upd_state)
+            row_ok = stability.finite_rows(x, y)
+            x = stability.zero_nonfinite_rows(x, row_ok)
+            y = stability.zero_nonfinite_rows(y, row_ok)
+            lm = lm * row_ok.reshape((row_ok.shape[0],)
+                                     + (1,) * (lm.ndim - 1))
+            (_, (loss, (new_ns, _))), grads = jax.value_and_grad(
+                stability.scaled_loss(net._loss_fn, stab), has_aux=True)(
+                params, net_state, x, y, rng, fm, lm, None)
+            # an all-rows-poisoned batch yields a zero loss and zero
+            # gradients — finite, but updating would still decay Adam
+            # moments toward the pad; veto it
+            new_params, new_us, new_ns, _ = stability.apply_guarded_update(
+                policy, cfg, stab, inner, params, net_state, loss, grads,
+                new_ns, iteration, lr_overrides,
+                extra_ok=jnp.sum(row_ok) > 0)
+            return (new_params, new_us, new_ns, loss,
+                    stability.slot_poison_flags(row_ok, K))
 
         in_shardings = (players, ulayers, repl, repl, data, data, repl, data,
                         data)
+        out_shardings = (players, ulayers, repl, repl)
+        if policy is not None:
+            out_shardings = out_shardings + (repl,)
         self._step = instrument(jax.jit(
             step,
             in_shardings=in_shardings,
-            out_shardings=(players, ulayers, repl, repl),
+            out_shardings=out_shardings,
             donate_argnums=(0, 1, 2),
         ), f"{type(self).__name__}.step", argnums=(3, 4, 5, 6, 7, 8))
         self._data_sharding = data
@@ -230,7 +271,7 @@ class SyncTrainingMaster(TrainingMaster):
         from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
         from deeplearning4j_tpu.models.common import notify_listeners
         from deeplearning4j_tpu.resilience import (
-            FitResilience, preemption_requested,
+            FitResilience, get_fault_injector, preemption_requested,
         )
 
         res = None
@@ -241,6 +282,24 @@ class SyncTrainingMaster(TrainingMaster):
                                 self.retry_policy, net=net, mesh=self.mesh)
         if isinstance(iterator, DataSetIterator) and iterator.async_supported():
             iterator = AsyncDataSetIterator(iterator, self.prefetch_size)
+        policy = net.conf.stability
+        if policy is not None:
+            from deeplearning4j_tpu.resilience import stability
+
+            # stability state must exist BEFORE device placement so the
+            # guard/scale scalars ride in upd_state under _upd_layout
+            stability.ensure_state(net)
+            created = self._stab_rt is None
+            if created:
+                slots = self._data_slot_devices()
+                self._stab_workers = [f"d{s[0].id}" for s in slots]
+                self._stab_rt = stability.StabilityRuntime(
+                    "sync_master", policy, worker_ids=self._stab_workers)
+            if created or (res is not None and res.resumed_from is not None):
+                # a restored nonfinite_total is history, not fresh evidence
+                self._stab_rt.baseline_from(
+                    net.updater_state.get(stability.STATE_KEY))
+        stab_rt = self._stab_rt
         if self._step is None:
             self._build(net)
         params = jax.device_put(net.params, self._params_layout)
@@ -275,23 +334,36 @@ class SyncTrainingMaster(TrainingMaster):
                 emask = self._elastic.begin_window(step0)
                 if emask.min() >= 1.0:
                     emask = None    # healthy mesh: untouched fast path
+            feats = ds.features
+            inj = get_fault_injector()
+            if inj is not None and inj.has_poison():
+                # deterministic chaos: data slot k owns the contiguous
+                # row block [k*B/K, (k+1)*B/K) of the global batch
+                # (poison flows regardless of the guard — the unguarded
+                # arm is the bench/test contrast)
+                if not self._stab_workers:
+                    self._stab_workers = [
+                        f"d{s[0].id}" for s in self._data_slot_devices()]
+                # poison_rows copies host-side only when a rule matches
+                feats = inj.poison_rows(self._stab_workers, step0, feats, K)
             t0 = time.perf_counter()
             with self._phases.phase("place"):
-                x = jax.device_put(jnp.asarray(ds.features), self._data_sharding)
+                x = jax.device_put(jnp.asarray(feats), self._data_sharding)
                 y = jax.device_put(jnp.asarray(ds.labels), self._data_sharding)
                 fm = None if ds.features_mask is None else jax.device_put(
                     jnp.asarray(ds.features_mask), self._data_sharding)
-                if self._elastic is None:
+                if self._elastic is None and stab_rt is None:
                     lm_host = ds.labels_mask
                 elif emask is not None:
                     lm_host = self._evicted_labels_mask(ds, emask, K)
                 elif ds.labels_mask is not None:
                     lm_host = ds.labels_mask
                 else:
-                    # elasticity keeps ONE trace: the mask argument is
-                    # always an array (all-ones == the unmasked mean), so
-                    # the first eviction flips values, not the pytree —
-                    # no recompile at the moment the mesh degrades
+                    # elasticity/stability keep ONE trace: the mask
+                    # argument is always an array (all-ones == the
+                    # unmasked mean), so the first eviction or poisoned
+                    # row flips values, not the pytree — no recompile at
+                    # the moment the mesh degrades
                     lm_host = np.ones(
                         (len(ds),) + (1,) * (ds.labels.ndim - 2),
                         np.float32)
@@ -301,20 +373,48 @@ class SyncTrainingMaster(TrainingMaster):
                             iteration=net.iteration):
                 with self._phases.phase("dispatch"):
                     if res is not None:
-                        params, upd_state, ns, loss = res.step(
+                        out = res.step(
                             lambda: self._step(
                                 params, upd_state, ns,
                                 jnp.asarray(float(net.iteration)),
                                 x, y, net._keys.next(), fm, lm),
                             net.iteration, net=net)
                     else:
-                        params, upd_state, ns, loss = self._step(
+                        out = self._step(
                             params, upd_state, ns,
                             jnp.asarray(float(net.iteration)),
                             x, y, net._keys.next(), fm, lm,
                         )
+                    if stab_rt is not None:
+                        params, upd_state, ns, loss, slot_poison = out
+                        # device-side add only; read at check boundaries
+                        stab_rt.accumulate(poison_flags=slot_poison)
+                    else:
+                        params, upd_state, ns, loss = out
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
+            if stab_rt is not None:
+                from deeplearning4j_tpu.resilience import stability
+
+                action = stab_rt.poll_master(
+                    step=net.iteration, losses=loss,
+                    stab_state=upd_state[stability.STATE_KEY],
+                    elastic=self._elastic,
+                    can_rewind=res is not None and res.cm is not None)
+                if action == "backoff":
+                    upd_state = stability.apply_lr_backoff_tree(
+                        upd_state, policy)
+                elif action == "rewind":
+                    net.params, net.updater_state, net.net_state = (
+                        params, upd_state, ns)
+                    if stab_rt.rewind(net, res.cm, mesh=self.mesh) is not None:
+                        # restage the rewound facade state onto the mesh
+                        params = jax.device_put(net.params,
+                                                self._params_layout)
+                        upd_state = jax.device_put(net.updater_state,
+                                                   self._upd_layout)
+                        ns = jax.device_put(net.net_state,
+                                            self._repl_sharding)
             if res is not None and res.cm is not None:
                 trigger = res.cm.due(net.iteration)
                 if trigger is not None:
@@ -338,8 +438,6 @@ class SyncTrainingMaster(TrainingMaster):
                 step_s = time.perf_counter() - t0
                 self._stats["step_time_ms"].append(step_s * 1e3)
                 per_dev = max(1, len(ds) // K)
-                from deeplearning4j_tpu.resilience import get_fault_injector
-
                 inj = get_fault_injector()
                 for worker, w_s in (worker_times
                                     or {str(i): step_s
@@ -355,6 +453,8 @@ class SyncTrainingMaster(TrainingMaster):
             self._phases.steps += 1
             notify_listeners(net, n_real)
         net.params, net.updater_state, net.net_state = params, upd_state, ns
+        if stab_rt is not None:
+            stab_rt.flush(net)   # tail past the last check boundary
 
     def _measure_worker_sync(self, loss, t_step0: float) -> Dict[str, float]:
         """Device-sync on the step result, measuring each device's shard

@@ -15,7 +15,11 @@ single-controller fault-tolerance layer (docs/resilience.md):
   drive the real paths with (fail a step, crash the checkpoint writer
   between files, corrupt a committed snapshot, slow a worker);
 - ``FitResilience`` — the per-fit-call driver the training loops embed
-  (auto-resume + skip, per-step retry scope, boundary save/stop duties).
+  (auto-resume + skip, per-step retry scope, boundary save/stop duties);
+- ``stability`` — the training-stability engine (device-side non-finite
+  step guard, dynamic loss scaling, divergence sentinel with LR backoff
+  and checkpoint auto-rewind, per-replica poison masking — docs/
+  resilience.md "Stability").
 """
 
 from deeplearning4j_tpu.resilience.checkpoint_manager import (
@@ -32,8 +36,10 @@ from deeplearning4j_tpu.resilience.preemption import (
 from deeplearning4j_tpu.resilience.retry import (
     RetryPolicy, TransientError, is_transient,
 )
+from deeplearning4j_tpu.resilience.stability import StabilityRuntime
 
 __all__ = [
+    "StabilityRuntime",
     "CheckpointError", "CheckpointManager",
     "FaultInjector", "InjectedFault", "TransientInjectedFault",
     "get_fault_injector", "inject_faults", "set_fault_injector",

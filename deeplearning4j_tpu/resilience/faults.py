@@ -24,6 +24,14 @@ well-defined points:
   elastic layer polls ``worker_state(k, step)`` at every window boundary
   and evicts, and ``until_step`` / ``clear_worker`` model the fault
   clearing so re-admission paths are just as deterministic;
+- ``poison_gradients(k, at_step, mode=nan|inf|spike, until_step=)`` —
+  worker ``k``'s minibatch features are poisoned before dispatch (the
+  fit loops and both data-parallel masters consult ``poison_batch`` /
+  ``poison_replica_slots`` / ``poison_rows``), so the injected NaN/Inf/
+  spike flows through the REAL forward/backward into the loss and
+  gradients — the deterministic harness for the stability engine's
+  device-side guard, per-replica poison masking, and divergence
+  sentinel (``resilience/stability.py``);
 - ``corrupt_checkpoint(dir)`` — post-hoc bit-flip / truncation / marker
   deletion of a COMMITTED checkpoint, for proving ``latest()`` skips torn
   snapshots.
@@ -73,6 +81,7 @@ class FaultInjector:
         self._files_seen = 0
         self._worker_delays: Dict[str, float] = {}
         self._worker_states: List[Dict[str, Any]] = []
+        self._poison_rules: List[Dict[str, Any]] = []
         self.injected: List[Dict[str, Any]] = []   # what fired, in order
 
     # ------------------------------------------------------------ step faults
@@ -195,18 +204,25 @@ class FaultInjector:
         return self
 
     def clear_worker(self, worker) -> "FaultInjector":
-        """Clear every armed hang/kill for ``worker`` (the fault is over;
-        an elastic run re-admits at the next window boundary)."""
+        """Clear every armed hang/kill/poison for ``worker`` (the fault
+        is over; an elastic run re-admits at the next window boundary)."""
         worker = str(worker)
         with self._lock:
             self._worker_states = [r for r in self._worker_states
                                    if r["worker"] != worker]
+            self._poison_rules = [r for r in self._poison_rules
+                                  if r["worker"] != worker]
         return self
 
     def worker_state(self, worker, step: int) -> str:
-        """``"ok"`` | ``"hung"`` | ``"dead"`` for ``worker`` at global
-        ``step`` — the elastic layer polls this at window boundaries.
-        ``"dead"`` wins over ``"hung"`` when both are armed."""
+        """``"ok"`` | ``"hung"`` | ``"dead"`` | ``"poisoned"`` for
+        ``worker`` at global ``step`` — the elastic layer polls this at
+        window boundaries.  ``dead`` > ``hung`` > ``poisoned`` when
+        several are armed.  A poisoned worker is NOT evicted on sight:
+        the device-side guard weights it out per window, and eviction
+        comes from the repeat-offender count (``TrainingStability.
+        poison_evict_after``) — but the state keeps an evicted
+        ``"poisoned"`` replica out until the rule clears."""
         worker = str(worker)
         state = "ok"
         with self._lock:
@@ -226,7 +242,131 @@ class FaultInjector:
                 if rule["kind"] == "dead":
                     return "dead"
                 state = "hung"
+        if state == "ok" and self.poison_mode(worker, step) is not None:
+            state = "poisoned"
         return state
+
+    # ----------------------------------------------------- gradient poison
+    def poison_gradients(self, worker, at_step: int = 0,
+                         mode: str = "nan", *,
+                         until_step: Optional[int] = None
+                         ) -> "FaultInjector":
+        """Worker ``k`` produces poisoned gradients from global step
+        ``at_step`` (same arming shape as ``hang_worker``/``kill_worker``).
+        Deterministically applied by the fit loops / parallel masters to
+        the worker's minibatch features BEFORE dispatch — poisoned data
+        is exactly the motivating failure (one bad batch/replica writes
+        NaN into params and the all-reduce broadcasts it), and it drives
+        the REAL device-side guard rather than a mock.  Modes: ``nan``
+        (features become NaN), ``inf`` (become +Inf), ``spike``
+        (scaled by 1e4 — finite but divergent, for sentinel tests).
+        ``until_step`` models the poison clearing (re-admission tests);
+        ``clear_worker`` clears it explicitly.  Single-device fit loops
+        poison under worker id ``"0"``."""
+        if mode not in ("nan", "inf", "spike"):
+            raise ValueError(f"unknown poison mode {mode!r}")
+        with self._lock:   # arming can race a live run's poison polls
+            self._poison_rules.append({
+                "worker": str(worker), "mode": mode,
+                "at_step": int(at_step),
+                "until_step": None if until_step is None
+                else int(until_step),
+                "fired": False,
+            })
+        return self
+
+    def has_poison(self) -> bool:
+        """Cheap hot-loop gate: any poison rule armed at all."""
+        with self._lock:
+            return bool(self._poison_rules)
+
+    def poison_mode(self, worker, step: int) -> Optional[str]:
+        """The poison mode active for ``worker`` at ``step``, or None."""
+        worker = str(worker)
+        with self._lock:
+            for rule in self._poison_rules:
+                if rule["worker"] != worker:
+                    continue
+                if int(step) < rule["at_step"]:
+                    continue
+                if (rule["until_step"] is not None
+                        and int(step) >= rule["until_step"]):
+                    continue
+                if not rule["fired"]:
+                    rule["fired"] = True
+                    self.injected.append({
+                        "kind": "worker_poisoned", "worker": worker,
+                        "mode": rule["mode"], "step": int(step)})
+                return rule["mode"]
+        return None
+
+    @staticmethod
+    def _apply_poison(mode: str, arr):
+        import numpy as np
+
+        arr = np.array(arr, copy=True)
+        if not np.issubdtype(arr.dtype, np.floating):
+            return arr                     # integer ids cannot be non-finite
+        if mode == "nan":
+            arr[...] = np.nan
+        elif mode == "inf":
+            arr[...] = np.inf
+        else:                              # spike: finite but divergent
+            arr *= 1e4
+        return arr
+
+    def _poison_tree(self, mode: str, tree):
+        """Apply poison to every floating array of a (possibly nested)
+        features structure; returns a poisoned copy."""
+        import numpy as np
+
+        if isinstance(tree, dict):
+            return {k: self._poison_tree(mode, v) for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(self._poison_tree(mode, v) for v in tree)
+        arr = np.asarray(tree)
+        return self._apply_poison(mode, arr)
+
+    def poison_batch(self, worker, step: int, x, y):
+        """Single-device hook (both facades): poison this step's features
+        when a rule for ``worker`` is live.  Labels are left alone — the
+        forward pass propagates the poison into loss AND gradients."""
+        mode = self.poison_mode(worker, step)
+        if mode is None:
+            return x, y
+        return self._poison_tree(mode, x), y
+
+    def poison_replica_slots(self, worker_ids, step: int, xs):
+        """ParallelWrapper hook: ``xs`` is the stacked ``[F, K, B, ...]``
+        window; replica ``k``'s slot is ``xs[:, k]``."""
+        import numpy as np
+
+        out = None
+        for k, worker in enumerate(worker_ids):
+            mode = self.poison_mode(worker, step)
+            if mode is None:
+                continue
+            if out is None:
+                out = np.array(xs, copy=True)
+            out[:, k] = self._apply_poison(mode, out[:, k])
+        return xs if out is None else out
+
+    def poison_rows(self, worker_ids, step: int, features, n_slots: int):
+        """SyncTrainingMaster hook: data slot ``k`` owns the contiguous
+        row block ``[k*B/K, (k+1)*B/K)`` of the global batch."""
+        import numpy as np
+
+        out = None
+        per = len(features) // n_slots
+        for k, worker in enumerate(worker_ids):
+            mode = self.poison_mode(worker, step)
+            if mode is None:
+                continue
+            if out is None:
+                out = np.array(features, copy=True)
+            rows = slice(k * per, (k + 1) * per)
+            out[rows] = self._apply_poison(mode, out[rows])
+        return features if out is None else out
 
     # --------------------------------------------------- on-disk corruption
     def corrupt_checkpoint(self, directory: str, mode: str = "truncate"
@@ -274,6 +414,7 @@ class FaultInjector:
             self._files_seen = 0
             self._worker_delays.clear()
             self._worker_states.clear()
+            self._poison_rules.clear()
             self.injected.clear()
             self.rng = random.Random(self.seed)
 

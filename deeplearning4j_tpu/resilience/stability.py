@@ -1,0 +1,595 @@
+"""Training stability engine: device-side non-finite step guard, dynamic
+loss scaling, and a host-side divergence sentinel with auto-rewind.
+
+The production spine can see, serve, diagnose, and survive crashes — but
+nothing protected a *live, healthy* run from numerical failure: one NaN
+gradient (bad batch, fp16 overflow, a poisoned replica) silently writes
+NaN into the params and the Adam moments, and in the data-parallel
+masters the all-reduce broadcasts the poison to every healthy replica.
+The reference shipped gradient-level guards as first-class capability
+(``GradientNormalization``, ``InvalidScoreIterationTerminationCondition``);
+this module is that idea rebuilt for the one-XLA-program world:
+
+- **non-finite step guard** (jit-safe half, used INSIDE every train
+  step): an all-finite reduction over loss + gradients, with the skip
+  folded into the update as a device-side mask
+  (``params = where(finite, new, old)``, updater state and net state
+  likewise) — a poisoned step is a no-op with zero host syncs and zero
+  recompiles, and a device counter in the stability state records it;
+- **loss scaling** (``TrainingStability.loss_scaling``): bf16/fp16
+  compute under fp32 master params is only safe when small gradients
+  don't flush to zero — the loss is multiplied by a scale before
+  ``grad``, gradients are unscaled before the updater, and in
+  ``dynamic`` mode the scale halves on overflow (a non-finite step) and
+  grows after ``loss_scale_growth_interval`` consecutive finite steps.
+  The scale state rides in the jitted step as part of the updater-state
+  pytree (``STATE_KEY`` subtree), so it shards, donates, and
+  checkpoints exactly like the Adam moments;
+- **divergence sentinel** (``StabilityRuntime``, host half): polled at
+  fit-loop boundaries every ``check_every`` steps (the ONLY points the
+  engine syncs device values), it watches the non-finite counter and a
+  rolling finite-loss baseline, and escalates: skip (free, device-side)
+  -> LR backoff (a device-carried multiplier on the update, exact for
+  every updater, zero recompiles) -> auto-rewind to the newest
+  ``CheckpointManager`` snapshot taken while the run was still healthy
+  (params/updater/RNG/iteration restored — PR-5 ``FitResilience``
+  replay semantics).  Every escalation is a flight event + metric;
+- **per-replica poison masking** (used by ``ParallelWrapper`` /
+  ``SyncTrainingMaster``): a replica whose window produced non-finite
+  gradients is weighted out of that window's average with the same
+  runtime ``[K]`` weight mask the elastic layer uses (zero recompiles);
+  a repeat offender is handed to the ``ElasticController`` as eviction
+  reason ``"poisoned"``.
+
+Metric families (docs/observability.md): ``dl4j_nonfinite_steps_total``,
+``dl4j_loss_scale``, ``dl4j_stability_lr_scale``,
+``dl4j_divergence_backoffs_total``, ``dl4j_divergence_rewinds_total``,
+``dl4j_poisoned_replica_windows_total``; the ``max_nonfinite_steps`` and
+``max_divergence_rewinds`` health rules read the counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Reserved subtree of the updater-state pytree.  Living inside updater
+# state means the scale/guard state is stacked per replica by
+# ParallelWrapper, sharded by the masters, donated with the step, and
+# checkpointed/restored by CheckpointManager without any extra plumbing.
+STATE_KEY = "__stability__"
+
+_NONFINITE = "dl4j_nonfinite_steps_total"
+_LOSS_SCALE = "dl4j_loss_scale"
+_LR_SCALE = "dl4j_stability_lr_scale"
+_BACKOFFS = "dl4j_divergence_backoffs_total"
+_REWINDS = "dl4j_divergence_rewinds_total"
+_POISONED = "dl4j_poisoned_replica_windows_total"
+
+
+# ---------------------------------------------------------------------------
+# jit-safe half: called INSIDE the train steps (no host syncs anywhere here)
+# ---------------------------------------------------------------------------
+
+def initial_state(policy) -> Dict[str, jax.Array]:
+    """Fresh device-side stability state (one scalar each; the facades
+    add it to ``updater_state`` at ``init()``)."""
+    scale = policy.loss_scale if policy.loss_scaling != "none" else 1.0
+    return {
+        "loss_scale": jnp.asarray(scale, jnp.float32),
+        "growth_streak": jnp.zeros((), jnp.float32),
+        "lr_scale": jnp.ones((), jnp.float32),
+        "nonfinite_total": jnp.zeros((), jnp.float32),
+    }
+
+
+def ensure_state(net) -> None:
+    """Make sure a stability-enabled net carries the state subtree (nets
+    initialized before the policy was set, deserialized nets)."""
+    policy = getattr(net.conf, "stability", None)
+    if policy is not None and STATE_KEY not in net.updater_state:
+        net.updater_state[STATE_KEY] = initial_state(policy)
+
+
+def split_state(upd_state):
+    """(stability subtree, remaining updater state) — trace-time split;
+    the remaining dict is what ``updaters.update`` understands."""
+    stab = upd_state[STATE_KEY]
+    inner = {k: v for k, v in upd_state.items() if k != STATE_KEY}
+    return stab, inner
+
+
+def scaled_loss(loss_fn, stab):
+    """Wrap a ``(loss, aux)`` loss function so ``grad`` differentiates
+    ``loss * loss_scale`` while the RAW loss stays observable in aux."""
+
+    def f(params, net_state, *args, **kwargs):
+        loss, aux = loss_fn(params, net_state, *args, **kwargs)
+        return loss * stab["loss_scale"], (loss, aux)
+
+    return f
+
+
+def all_finite(loss, grads) -> jax.Array:
+    """Scalar bool: the loss and every gradient leaf are finite.
+
+    One reduction per leaf: a leaf containing NaN or ±Inf makes its sum
+    non-finite (Inf terms of opposite sign collapse to NaN), so
+    ``isfinite(Σ leaf-sums + loss)`` is the whole verdict — the classic
+    mixed-precision overflow check, half the passes of a per-element
+    ``isfinite``-then-``all``."""
+    total = jnp.asarray(loss, jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(grads):
+        total = total + jnp.sum(leaf).astype(jnp.float32)
+    return jnp.isfinite(total)
+
+
+def select(flag, new_tree, old_tree):
+    """Per-leaf ``where(flag, new, old)`` — the device-side skip mask."""
+    return jax.tree_util.tree_map(
+        lambda n, o: jnp.where(flag, n, o), new_tree, old_tree)
+
+
+def next_state(policy, stab, finite) -> Dict[str, jax.Array]:
+    """Advance the stability state by one step's finiteness verdict
+    (dynamic loss-scale grow/halve, non-finite counter)."""
+    fin = finite.astype(jnp.float32)
+    scale = stab["loss_scale"]
+    streak = stab["growth_streak"]
+    if policy.loss_scaling == "dynamic":
+        streak = jnp.where(finite, streak + 1.0, 0.0)
+        grow = streak >= policy.loss_scale_growth_interval
+        scale = jnp.where(
+            finite & grow,
+            jnp.minimum(scale * policy.loss_scale_factor,
+                        policy.loss_scale_max),
+            scale)
+        streak = jnp.where(grow, 0.0, streak)
+        scale = jnp.where(
+            finite, scale,
+            jnp.maximum(scale / policy.loss_scale_factor,
+                        policy.loss_scale_min))
+    return {
+        "loss_scale": scale,
+        "growth_streak": streak,
+        "lr_scale": stab["lr_scale"],
+        "nonfinite_total": stab["nonfinite_total"] + (1.0 - fin),
+    }
+
+
+def apply_guarded_update(policy, cfg, stab, inner_state, params, net_state,
+                         loss, grads, new_ns, iteration, lr_overrides,
+                         extra_ok=None):
+    """Shared guarded tail of every train step: unscale the gradients,
+    take the finiteness verdict, run the updater, and fold the skip into
+    the update as a device-side mask.  Returns ``(new_params,
+    new_upd_state_with_stability, net_state_out, finite)``.
+
+    ``extra_ok`` lets a caller veto the update with additional device
+    evidence (the sync master vetoes a window whose every row was
+    poisoned — zero-gradient steps still decay Adam moments)."""
+    from deeplearning4j_tpu.optimize import updaters as upd
+
+    grads = {k: v for k, v in grads.items() if v}
+    inv = 1.0 / stab["loss_scale"]
+    grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+    finite = all_finite(loss, grads)
+    if extra_ok is not None:
+        finite = finite & extra_ok
+    updates, new_inner = upd.update(cfg, grads, inner_state, iteration,
+                                    lr_overrides, params=params)
+    # the params-tree skip is folded into the update itself: the update
+    # becomes EXACTLY 0.0 on a poisoned step, so params - 0 == params
+    # bit-for-bit with no second where-pass over the param tree.  A NaN
+    # update times 0 would stay NaN, hence where-to-zero BEFORE the
+    # scale (XLA fuses both into one elementwise pass).
+    lr_scale = stab["lr_scale"]
+    if policy.skip_nonfinite:
+        scale = jnp.where(finite, lr_scale, 0.0)
+        updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)) * scale,
+            updates)
+    else:
+        updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
+    new_params = dict(params)
+    for lname, u in updates.items():
+        new_params[lname] = upd.apply_updates(params[lname], u)
+    if policy.skip_nonfinite:
+        new_inner = select(finite, new_inner, inner_state)
+        new_ns = select(finite, new_ns, net_state)
+    new_inner = dict(new_inner)
+    new_inner[STATE_KEY] = next_state(policy, stab, finite)
+    return new_params, new_inner, new_ns, finite
+
+
+def finite_rows(x, y) -> jax.Array:
+    """``[B]`` float mask: 1 where every floating element of the
+    example's features AND labels is finite (integer leaves — token ids —
+    cannot be non-finite and pass).  The sync master folds this into the
+    labels mask so poisoned rows renormalize out of the global gradient
+    mean exactly like an elastic eviction."""
+
+    def rows_ok(tree):
+        ok = None
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if not jnp.issubdtype(leaf.dtype, jnp.floating):
+                continue
+            lo = jnp.all(jnp.isfinite(leaf).reshape(leaf.shape[0], -1),
+                         axis=1)
+            ok = lo if ok is None else ok & lo
+        return ok
+
+    ok = rows_ok(x)
+    oy = rows_ok(y)
+    if ok is None and oy is None:
+        leaves = jax.tree_util.tree_leaves(x)
+        return jnp.ones((leaves[0].shape[0],), jnp.float32)
+    if ok is None:
+        ok = oy
+    elif oy is not None:
+        ok = ok & oy
+    return ok.astype(jnp.float32)
+
+
+def zero_nonfinite_rows(tree, row_ok):
+    """Replace poisoned rows of every floating leaf with zeros BEFORE the
+    forward pass.  Masking the loss alone is not enough: NaN/Inf
+    activations poison the backward pass even under a zero cotangent
+    (0 * NaN = NaN), so the poison must never enter the graph."""
+
+    def clean(leaf):
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        m = row_ok.reshape((leaf.shape[0],) + (1,) * (leaf.ndim - 1))
+        return jnp.where(m > 0, leaf, jnp.zeros_like(leaf))
+
+    return jax.tree_util.tree_map(clean, tree)
+
+
+def slot_poison_flags(row_ok, n_slots: int) -> jax.Array:
+    """``[K]`` flags: 1 where ANY row of the slot's contiguous batch
+    block is poisoned (the sync master's data layout: slot k owns rows
+    ``[k*B/K, (k+1)*B/K)``)."""
+    per_slot = row_ok.reshape(n_slots, -1)
+    return 1.0 - jnp.min(per_slot, axis=1)
+
+
+def apply_lr_backoff_tree(upd_state, policy):
+    """New updater-state tree with the device-carried LR scale multiplied
+    by the backoff factor (pure device op — no host sync; works on the
+    facades' scalar state and the wrapper's stacked ``[K]`` state
+    alike)."""
+    stab = dict(upd_state[STATE_KEY])
+    stab["lr_scale"] = stab["lr_scale"] * policy.lr_backoff
+    out = dict(upd_state)
+    out[STATE_KEY] = stab
+    return out
+
+
+# ---------------------------------------------------------------------------
+# host half: boundary harvest, divergence sentinel, escalation
+# ---------------------------------------------------------------------------
+
+class StabilityRuntime:
+    """Per-component host-side driver (one per facade fit / master).
+
+    The fit loops call ``poll_net`` (facades) or ``accumulate`` +
+    ``poll_master`` (parallel masters) once per step/window boundary;
+    everything is a no-op except every ``policy.check_every``-th call,
+    where the runtime syncs the tiny device scalars it harvests
+    (non-finite counter, loss scale, window loss), publishes metrics,
+    and runs the divergence sentinel.  Escalation actions:
+
+    - ``"backoff"`` — multiply the device-carried LR scale by
+      ``policy.lr_backoff`` (the caller applies it to its live updater
+      state via ``apply_lr_backoff_tree``);
+    - ``"rewind"`` — restore the newest checkpoint committed while the
+      run was still healthy (``rewind``), then back off the LR so the
+      rewound run does not immediately re-diverge.
+    """
+
+    def __init__(self, component: str, policy, *,
+                 worker_ids: Optional[List[str]] = None, registry=None):
+        self.component = component
+        self.policy = policy
+        self.worker_ids = [str(w) for w in (worker_ids or [])]
+        if registry is None:
+            from deeplearning4j_tpu.observability import get_registry
+            registry = get_registry()
+        self._m_nonfinite = registry.counter(
+            _NONFINITE, "Training steps whose loss or gradients were "
+            "non-finite — the device-side guard made them no-ops "
+            "(params/updater/net state unchanged); harvested from the "
+            "device counter at window boundaries",
+            labels=("component",))
+        self._m_scale = registry.gauge(
+            _LOSS_SCALE, "Current dynamic loss scale of the stability "
+            "engine (1 when loss scaling is off)", labels=("component",))
+        self._m_lr_scale = registry.gauge(
+            _LR_SCALE, "Divergence-sentinel LR backoff multiplier applied "
+            "device-side to every update (1 until the first backoff "
+            "escalation)", labels=("component",))
+        self._m_backoffs = registry.counter(
+            _BACKOFFS, "Divergence-sentinel LR-backoff escalations "
+            "(sustained non-finite streak or finite loss spike)",
+            labels=("component",))
+        self._m_rewinds = registry.counter(
+            _REWINDS, "Divergence-sentinel auto-rewinds to the last good "
+            "checkpoint (params/updater/RNG/iteration restored; read by "
+            "the max_divergence_rewinds health rule)",
+            labels=("component",))
+        self._m_poisoned = registry.counter(
+            _POISONED, "Averaging windows in which the named replica's "
+            "gradients were non-finite and it was weighted out of the "
+            "window average", labels=("component", "worker"))
+        self._calls = 0
+        self._checks = 0
+        self._harvested_nonfinite = 0.0
+        self._harvested_poison: Dict[str, float] = {}
+        self._lr_scale_host = 1.0
+        self._baseline = collections.deque(maxlen=16)
+        self._spike_strikes = 0
+        self._level = 0
+        self._cooldown_until = -1
+        self._last_good_step: Optional[int] = None
+        # device-side accumulators (masters feed these via accumulate())
+        self._nf_acc = None
+        self._poison_acc = None
+
+    def baseline_from(self, stab_state) -> None:
+        """Anchor the harvest baseline on an EXISTING device counter — a
+        checkpointed ``nonfinite_total`` restored by auto-resume (or an
+        earlier fit) is history, not fresh evidence; without this anchor
+        the first check of a resumed run would re-publish the whole
+        historical count and could trip a spurious escalation.  One
+        scalar sync, at fit entry / after a rewind only.  A no-op for
+        runtimes fed by ``accumulate`` (the wrapper): their counter
+        starts at this process's zero by construction."""
+        if stab_state is None or self._nf_acc is not None:
+            return
+        self._harvested_nonfinite = float(
+            np.asarray(stab_state["nonfinite_total"]).reshape(-1)[0])
+
+    # ----------------------------------------------------- device feeding
+    def accumulate(self, nonfinite_count=None, poison_flags=None) -> None:
+        """Fold one window's device-side verdicts into the runtime's
+        device accumulators (pure jnp adds — no sync; the sums are read
+        at the next check boundary).  Callers whose non-finite counter
+        already lives in a replicated stability state (the sync master)
+        pass only ``poison_flags``."""
+        if nonfinite_count is not None:
+            self._nf_acc = (nonfinite_count if self._nf_acc is None
+                            else self._nf_acc + nonfinite_count)
+        if poison_flags is not None:
+            self._poison_acc = (poison_flags if self._poison_acc is None
+                                else self._poison_acc + poison_flags)
+
+    # ----------------------------------------------------------- polling
+    def poll_net(self, net, res=None) -> Optional[str]:
+        """Facade boundary duty: harvest + sentinel every ``check_every``
+        steps; applies backoff/rewind to the facade in place.  Returns
+        the action taken (telemetry/testing convenience)."""
+        self._calls += 1
+        if self._calls % self.policy.check_every:
+            return None
+        stab = net.updater_state.get(STATE_KEY)
+        if stab is None:
+            return None
+        # the ONLY host syncs in the engine: a handful of scalars, once
+        # per check window, on values whose compute has already retired
+        nonfinite_total = float(np.asarray(stab["nonfinite_total"]))
+        self._lr_scale_host = float(np.asarray(stab["lr_scale"]))
+        loss = net.score_value
+        self._publish(nonfinite_total, float(np.asarray(stab["loss_scale"])))
+        action = self._verdict(int(net.iteration), loss,
+                               nonfinite_total - self._harvested_nonfinite)
+        self._harvested_nonfinite = nonfinite_total
+        if action == "backoff":
+            net.updater_state = apply_lr_backoff_tree(
+                net.updater_state, self.policy)
+            self._record_backoff(int(net.iteration))
+        elif action == "rewind":
+            cm = res.cm if res is not None else None
+            if cm is None or self.rewind(net, cm) is None:
+                # no checkpoint manager / nothing restorable: the best
+                # remaining lever is a (further) LR backoff
+                net.updater_state = apply_lr_backoff_tree(
+                    net.updater_state, self.policy)
+                self._record_backoff(int(net.iteration))
+                action = "backoff"
+        return action
+
+    def flush(self, net=None, stab_state=None) -> None:
+        """Final harvest at fit exit: publish whatever the device counter
+        accumulated since the last check boundary (no sentinel verdict —
+        the run is over; early stopping and health rules read the
+        metrics)."""
+        if stab_state is None and net is not None:
+            stab_state = net.updater_state.get(STATE_KEY)
+        nonfinite_total = None
+        scale = 1.0
+        if self._nf_acc is not None:
+            nonfinite_total = float(np.asarray(self._nf_acc))
+        if stab_state is not None:
+            if nonfinite_total is None:
+                nonfinite_total = float(
+                    np.asarray(stab_state["nonfinite_total"]).reshape(-1)[0])
+            scale = float(np.asarray(stab_state["loss_scale"]).reshape(-1)[0])
+            self._lr_scale_host = float(
+                np.asarray(stab_state["lr_scale"]).reshape(-1)[0])
+        if nonfinite_total is None:
+            return
+        self._publish(nonfinite_total, scale)
+        self._harvested_nonfinite = nonfinite_total
+        self._harvest_poison(int(getattr(net, "iteration", 0) or 0), None)
+
+    def poll_master(self, *, step: int, losses=None, stab_state=None,
+                    elastic=None, can_rewind: bool = True) -> Optional[str]:
+        """Master boundary duty: harvest the device accumulators (and/or
+        the replicated stability state), publish per-replica poison
+        verdicts, run the sentinel.  Returns ``None`` | ``"backoff"`` |
+        ``"rewind"`` — the caller owns the live device trees and applies
+        the action itself.  ``can_rewind=False`` (no checkpoint manager)
+        downgrades a rewind verdict to a further backoff, mirroring
+        ``poll_net``'s fallback — otherwise an unrewindable run would
+        discard every escalation after the first."""
+        self._calls += 1
+        if self._calls % self.policy.check_every:
+            return None
+        nonfinite_total = self._harvested_nonfinite
+        if self._nf_acc is not None:
+            nonfinite_total = float(np.asarray(self._nf_acc))
+        elif stab_state is not None:
+            nonfinite_total = float(np.asarray(stab_state["nonfinite_total"]))
+        scale = 1.0
+        if stab_state is not None:
+            scale = float(np.asarray(stab_state["loss_scale"]).reshape(-1)[0])
+            self._lr_scale_host = float(
+                np.asarray(stab_state["lr_scale"]).reshape(-1)[0])
+        self._publish(nonfinite_total, scale)
+        self._harvest_poison(step, elastic)
+        loss = None
+        if losses is not None:
+            arr = np.asarray(losses, np.float64)
+            # poisoned replicas report NaN losses; judge the healthy ones
+            loss = (float(np.nanmean(arr))
+                    if np.isfinite(arr).any() else float("nan"))
+        action = self._verdict(step, loss,
+                               nonfinite_total - self._harvested_nonfinite)
+        self._harvested_nonfinite = nonfinite_total
+        if action == "rewind" and not can_rewind:
+            action = "backoff"
+        if action == "backoff":
+            self._record_backoff(step)
+        return action
+
+    def _harvest_poison(self, step: int, elastic) -> None:
+        if self._poison_acc is None or not self.worker_ids:
+            return
+        counts = np.asarray(self._poison_acc, np.float64).reshape(-1)
+        for k, worker in enumerate(self.worker_ids):
+            total = float(counts[k]) if k < len(counts) else 0.0
+            prev = self._harvested_poison.get(worker, 0.0)
+            # only a count that ADVANCED since the last check is evidence:
+            # a re-admitted replica must not be re-evicted on its old
+            # cumulative total
+            if total <= prev:
+                continue
+            self._m_poisoned.inc(total - prev, component=self.component,
+                                 worker=worker)
+            from deeplearning4j_tpu.observability import (
+                get_flight_recorder,
+            )
+            get_flight_recorder().record(
+                "replica_poisoned", component=self.component,
+                worker=worker, windows=int(total), step=int(step))
+            self._harvested_poison[worker] = total
+            if (elastic is not None
+                    and total >= self.policy.poison_evict_after):
+                elastic.report_poisoned(worker, step)
+
+    # ------------------------------------------------------ sentinel core
+    def _publish(self, nonfinite_total: float, loss_scale: float) -> None:
+        delta = nonfinite_total - self._harvested_nonfinite
+        if delta > 0:
+            self._m_nonfinite.inc(delta, component=self.component)
+            from deeplearning4j_tpu.observability import get_flight_recorder
+            get_flight_recorder().record(
+                "nonfinite_steps", component=self.component,
+                count=int(delta), total=int(nonfinite_total))
+        self._m_scale.set(loss_scale, component=self.component)
+        self._m_lr_scale.set(self._lr_scale_host, component=self.component)
+
+    def _verdict(self, step: int, loss: Optional[float],
+                 nf_delta: float) -> Optional[str]:
+        """Escalation decision for one check window."""
+        self._checks += 1
+        sustained_nf = nf_delta >= self.policy.nonfinite_streak
+        spike = False
+        healthy_loss = (loss is not None and math.isfinite(loss))
+        if healthy_loss:
+            base = (sorted(self._baseline)[len(self._baseline) // 2]
+                    if self._baseline else None)
+            if (base is not None
+                    and loss > self.policy.spike_factor * abs(base) + 1e-6):
+                self._spike_strikes += 1
+                spike = self._spike_strikes >= self.policy.spike_patience
+            else:
+                self._spike_strikes = 0
+                self._baseline.append(loss)
+        if not (sustained_nf or spike):
+            if nf_delta == 0 and (loss is None or healthy_loss) \
+                    and self._spike_strikes == 0:
+                self._last_good_step = step
+                self._level = 0
+            return None
+        if self._checks <= self._cooldown_until:
+            return None
+        self._level += 1
+        return "backoff" if self._level == 1 else "rewind"
+
+    def _record_backoff(self, step: int) -> None:
+        from deeplearning4j_tpu.observability import get_flight_recorder
+
+        self._lr_scale_host *= self.policy.lr_backoff
+        self._m_backoffs.inc(component=self.component)
+        self._m_lr_scale.set(self._lr_scale_host, component=self.component)
+        get_flight_recorder().record(
+            "divergence_backoff", component=self.component, step=int(step),
+            lr_scale=self._lr_scale_host)
+        self._cooldown_until = self._checks + 1
+
+    # ------------------------------------------------------------ rewind
+    def rewind(self, net, cm, *, mesh=None) -> Optional[int]:
+        """Restore the newest checkpoint committed while the run was
+        still healthy (falling back to the oldest committed snapshot when
+        the whole retention window post-dates the divergence), apply an
+        LR backoff so the rewound run does not re-diverge into the same
+        wall, and re-arm the sentinel.  Returns the restored step, or
+        None when nothing was restorable."""
+        from deeplearning4j_tpu.observability import get_flight_recorder
+
+        from_step = int(getattr(net, "iteration", 0))
+        steps = cm.all_steps()
+        good = [s for s in steps
+                if self._last_good_step is None or s <= self._last_good_step]
+        # newest snapshot from the healthy era first; if the whole
+        # retention window post-dates the divergence, oldest-first is the
+        # least-diverged state still on disk
+        candidates = [max(good)] if good else []
+        candidates += [s for s in sorted(steps) if s not in candidates]
+        restored = None
+        for target in candidates:
+            try:
+                cm.restore(net, step=target, mesh=mesh)
+                restored = target
+                break
+            except (FileNotFoundError, OSError):
+                continue
+        if restored is None:
+            get_flight_recorder().record(
+                "divergence_rewind_unavailable", component=self.component,
+                step=from_step)
+            return None
+        ensure_state(net)
+        net.updater_state = apply_lr_backoff_tree(net.updater_state,
+                                                  self.policy)
+        # the restored counter is OLDER than the last harvest; re-anchor
+        # so post-rewind deltas measure post-rewind evidence only
+        self.baseline_from(net.updater_state.get(STATE_KEY))
+        self._lr_scale_host *= self.policy.lr_backoff
+        self._m_rewinds.inc(component=self.component)
+        self._m_lr_scale.set(self._lr_scale_host, component=self.component)
+        get_flight_recorder().record(
+            "divergence_rewind", component=self.component,
+            from_step=from_step, to_step=int(net.iteration))
+        self._level = 0
+        self._spike_strikes = 0
+        self._cooldown_until = self._checks + self.policy.rewind_cooldown_checks
+        self._baseline.clear()
+        return restored

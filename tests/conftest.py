@@ -70,6 +70,12 @@ def pytest_configure(config):
         "lint: source-level static-analysis gates — the dl4jlint rule "
         "suite, its ratcheting baseline, and the metrics-docs/"
         "bench-sentinel shims (python -m pytest -m lint)")
+    config.addinivalue_line(
+        "markers",
+        "stability: training-stability engine tests — device-side "
+        "non-finite step guard, loss scaling, divergence sentinel with "
+        "auto-rewind, per-replica poison masking "
+        "(python -m pytest -m stability)")
 
 
 def pytest_collection_modifyitems(config, items):
