@@ -1233,6 +1233,95 @@ def _stability_rewinds():
     return get_registry().family_total("dl4j_divergence_rewinds_total")
 
 
+def bench_introspection(platform, peak):
+    """The introspection layer's contract on record (docs/observability.md
+    "Training introspection"): stats-on vs stats-off end-to-end fit-step
+    time on the bench transformer with a StatsListener at
+    reporting_frequency=10 — the per-layer gradient/update/activation
+    reductions are fused into the step and the harvest is one batched
+    transfer per 10th step, so the overhead must stay <5%."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+    from deeplearning4j_tpu.nn.conf import TrainingIntrospection
+    from deeplearning4j_tpu.ui import (
+        InMemoryStatsStorage, StatsListener, StatsUpdateConfiguration,
+    )
+
+    if platform == "tpu":
+        batch, seq, d_model, heads, layers = 8, 2048, 1024, 8, 8
+    else:
+        batch, seq, d_model, heads, layers = 2, 256, 64, 2, 1
+    vocab = 128
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
+    warmup, iters, reps = (3, 30, 3) if platform == "tpu" else (3, 15, 5)
+
+    def make_one(introspection):
+        net = transformer_char_lm(
+            vocab_size=vocab, d_model=d_model, n_heads=heads, layers=layers,
+            compute_dtype="bfloat16" if platform == "tpu" else None,
+            introspection=introspection)
+        if introspection is not None:
+            net.set_listeners(StatsListener(
+                InMemoryStatsStorage(),
+                config=StatsUpdateConfiguration(
+                    reporting_frequency=10, collect_memory=False,
+                    collect_histograms_params=False,
+                    collect_mean_magnitudes=False)))
+
+        def one():
+            # the full fit path: step dispatch + listener notification
+            # (incl. the every-10th-step introspection harvest)
+            net.fit(x, y)
+            return net._score
+
+        return one
+
+    off_one = make_one(None)
+    on_one = make_one(TrainingIntrospection())
+
+    def timed_loop(one):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = one()
+        _sync(out)
+        return (time.perf_counter() - t0) / iters
+
+    for _ in range(warmup):   # compile + warm BOTH arms before timing
+        off_one()
+        on_one()
+    # overhead_frac is a difference of two noisy medians: INTERLEAVE the
+    # arms per rep so slow-container drift (the dominant CPU noise) hits
+    # both sides of the ratio instead of whichever arm ran second
+    t_off, t_on = [], []
+    for _ in range(reps):
+        t_off.append(timed_loop(off_one))
+        t_on.append(timed_loop(on_one))
+    off_s = float(np.median(t_off))
+    on_s = float(np.median(t_on))
+    overhead = on_s / off_s - 1.0
+    spread = {"reps": reps,
+              "on_rep_ms": [round(t * 1e3, 3) for t in t_on],
+              "off_rep_ms": [round(t * 1e3, 3) for t in t_off]}
+    return {
+        "metric": (f"Introspected train step (transformer d{d_model} "
+                   f"L{layers} T{seq}, per-layer stats in-graph, "
+                   f"report every 10)"),
+        "value": round(on_s * 1e3, 3),
+        "unit": "ms/step",
+        "vs_baseline": None,   # reference collected host-side via SBE
+        "data": "synthetic",
+        "dtype": "bfloat16" if platform == "tpu" else "float32",
+        "stats_off_ms": round(off_s * 1e3, 3),
+        "overhead_frac": round(overhead, 4),
+        "spread": spread,
+    }
+
+
 def _performance_attribution(metrics, dev):
     """The observability.performance section: step FLOPs, MFU (spec-sheet
     peak on TPU, documented CPU estimate otherwise — always labeled), and
@@ -1292,7 +1381,8 @@ def main():
             ("checkpoint", lambda: bench_checkpoint(platform, peak)),
             ("elastic", lambda: bench_elastic(platform, peak)),
             ("online", lambda: bench_online(platform, peak)),
-            ("stability", lambda: bench_stability(platform, peak))):
+            ("stability", lambda: bench_stability(platform, peak)),
+            ("introspection", lambda: bench_introspection(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

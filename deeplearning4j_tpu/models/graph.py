@@ -28,7 +28,9 @@ from deeplearning4j_tpu.observability import (
     crash_dump, fit_telemetry, instrument, step_guard,
 )
 from deeplearning4j_tpu.nn import losses as losses_mod
-from deeplearning4j_tpu.nn.conf import TrainingStability, UpdaterConfig
+from deeplearning4j_tpu.nn.conf import (
+    TrainingIntrospection, TrainingStability, UpdaterConfig,
+)
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
 from deeplearning4j_tpu.nn.layers.dense import OutputLayer
@@ -80,6 +82,8 @@ class GraphConfiguration:
     compute_dtype: Optional[str] = None  # mixed precision, as MLN conf
     # training-stability engine (nn.conf.TrainingStability), as MLN conf
     stability: Optional[Any] = None
+    # training-introspection engine (nn.conf.TrainingIntrospection)
+    introspection: Optional[Any] = None
 
     def topological_order(self) -> List[str]:
         """Kahn's algorithm over the DAG (reference
@@ -148,6 +152,8 @@ class GraphConfiguration:
                 "compute_dtype": self.compute_dtype,
                 "stability": (self.stability.to_dict()
                               if self.stability else None),
+                "introspection": (self.introspection.to_dict()
+                                  if self.introspection else None),
             },
             indent=2,
         )
@@ -170,6 +176,8 @@ class GraphConfiguration:
             compute_dtype=d.get("compute_dtype"),
             stability=(TrainingStability.from_dict(d["stability"])
                        if d.get("stability") else None),
+            introspection=(TrainingIntrospection.from_dict(d["introspection"])
+                           if d.get("introspection") else None),
         )
 
 
@@ -242,6 +250,7 @@ class GraphBuilder:
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
             stability=p._stability,
+            introspection=p._introspection,
         )
         conf.validate()
         # shape inference pass: complete layers with n_in from input types
@@ -337,6 +346,11 @@ class ComputationGraph(LazyScoreMixin):
             # stacks, shards, donates, and checkpoints like Adam moments
             self.updater_state[stability.STATE_KEY] = (
                 stability.initial_state(self.conf.stability))
+        if self.conf.introspection is not None:
+            from deeplearning4j_tpu.observability import introspection
+
+            # per-layer stat vectors ride in the updater-state pytree too
+            introspection.ensure_state(self)
         return self
 
     def num_params(self) -> int:
@@ -424,7 +438,7 @@ class ComputationGraph(LazyScoreMixin):
         return acts, new_state, new_carries
 
     def _loss_fn(self, params, net_state, inputs, labels, rng, fmask=None,
-                 lmask=None, carries=None, train=True):
+                 lmask=None, carries=None, train=True, collect_acts=False):
         """inputs: dict name->array (or single array for 1-input graphs);
         labels: dict output-name->array or single array."""
         inputs = self._as_input_dict(inputs)
@@ -445,6 +459,17 @@ class ComputationGraph(LazyScoreMixin):
         for n in self.conf.nodes:
             if n.layer is not None and n.layer.has_params():
                 total = total + n.layer.reg_score(params[n.name])
+        if collect_acts:
+            # introspection: per-layer-node activation summaries reduced
+            # in-graph (same node order as IntrospectPlan.act_names)
+            from deeplearning4j_tpu.observability import introspection
+
+            policy = self.conf.introspection
+            act_stats = introspection.act_summary(
+                [(n.name, acts[n.name]) for n in self.conf.nodes
+                 if n.layer is not None],
+                dead_eps=policy.dead_eps if policy is not None else 0.0)
+            return total, (new_state, new_carries, act_stats)
         return total, (new_state, new_carries)
 
     def _as_input_dict(self, inputs):
@@ -466,6 +491,7 @@ class ComputationGraph(LazyScoreMixin):
         """The raw (un-jitted) SGD step shared by the per-batch train step
         and the scanned multi-step window (mirrors
         ``MultiLayerNetwork._step_core``)."""
+        from deeplearning4j_tpu.observability import introspection
         from deeplearning4j_tpu.optimize import updaters as upd
 
         cfg = self.conf.updater
@@ -476,19 +502,31 @@ class ComputationGraph(LazyScoreMixin):
         }
 
         policy = self.conf.stability
+        plan = introspection.plan_for(self)
 
         def step(params, upd_state, net_state, iteration, inputs, labels,
                  rng, fmask, lmask, carries):
+            if plan is not None:
+                _, upd_state = introspection.split_state(upd_state)
+            kw = ({"collect_acts": True}
+                  if plan is not None and plan.collect_acts else {})
             if policy is None:
-                (loss, (new_ns, new_carries)), grads = jax.value_and_grad(
+                (loss, aux), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True
-                )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
+                )(params, net_state, inputs, labels, rng, fmask, lmask,
+                  carries, **kw)
+                new_ns, new_carries, act_stats = (
+                    introspection.unpack_aux(plan, aux))
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration,
                                              lr_overrides, params=params)
                 new_params = dict(params)
                 for lname, u in updates.items():
                     new_params[lname] = upd.apply_updates(params[lname], u)
+                introspection.attach(
+                    new_us, plan, grads=grads, params=params,
+                    new_params=new_params, iteration=iteration,
+                    act_stats=act_stats)
                 return new_params, new_us, new_ns, loss, new_carries
             # non-finite step guard + loss scaling: a poisoned step folds
             # into a device-side no-op (resilience/stability.py; same
@@ -496,13 +534,20 @@ class ComputationGraph(LazyScoreMixin):
             from deeplearning4j_tpu.resilience import stability
 
             stab, inner = stability.split_state(upd_state)
-            (_, (loss, (new_ns, new_carries))), grads = jax.value_and_grad(
+            (_, (loss, aux)), grads = jax.value_and_grad(
                 stability.scaled_loss(self._loss_fn, stab), has_aux=True
-            )(params, net_state, inputs, labels, rng, fmask, lmask, carries)
+            )(params, net_state, inputs, labels, rng, fmask, lmask,
+              carries, **kw)
+            new_ns, new_carries, act_stats = (
+                introspection.unpack_aux(plan, aux))
             new_params, new_us, new_ns, finite = (
                 stability.apply_guarded_update(
                     policy, cfg, stab, inner, params, net_state,
                     loss, grads, new_ns, iteration, lr_overrides))
+            introspection.attach(
+                new_us, plan, grads=grads, params=params,
+                new_params=new_params, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
             if new_carries is not None and policy.skip_nonfinite:
                 # poisoned TBPTT window: reset the recurrent stream state
                 # rather than carrying NaN into the next window
@@ -560,6 +605,11 @@ class ComputationGraph(LazyScoreMixin):
             raise ValueError("fit_scanned requires SGD optimization")
         if self.conf.backprop_type == "truncated_bptt":
             raise ValueError("fit_scanned does not support TBPTT")
+        if self.conf.introspection is not None:
+            from deeplearning4j_tpu.observability import introspection
+
+            introspection.ensure_state(self)
+            self._introspect_live = None
         scanned = self._jit_cache.setdefault(
             "scanned_step", self._make_scanned_step())
         for _ in range(epochs):
@@ -659,6 +709,12 @@ class ComputationGraph(LazyScoreMixin):
                 # a restored nonfinite_total is history, not fresh evidence
                 self._stab_rt.baseline_from(
                     self.updater_state.get(stability.STATE_KEY))
+        if self.conf.introspection is not None:
+            from deeplearning4j_tpu.observability import introspection
+
+            introspection.ensure_state(self)
+            # facade updater_state is authoritative during a solo fit
+            self._introspect_live = None
         from deeplearning4j_tpu.resilience import preemption_requested
 
         try:

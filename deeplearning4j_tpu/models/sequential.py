@@ -80,6 +80,13 @@ class MultiLayerNetwork(LazyScoreMixin):
             # stacks, shards, donates, and checkpoints like Adam moments
             self.updater_state[stability.STATE_KEY] = (
                 stability.initial_state(self.conf.stability))
+        if self.conf.introspection is not None:
+            from deeplearning4j_tpu.observability import introspection
+
+            # per-layer stat vectors ride in the updater-state pytree
+            # too: stacked per replica, replicated by the sync master,
+            # donated, checkpointed (docs/observability.md)
+            introspection.ensure_state(self)
         return self
 
     def _trainable(self, params):
@@ -176,12 +183,13 @@ class MultiLayerNetwork(LazyScoreMixin):
 
     # ----------------------------------------------------------------- score
     def _loss_fn(self, params, net_state, x, y, rng, fmask=None, lmask=None,
-                 carries=None, train=True):
+                 carries=None, train=True, collect_acts=False):
         out_layer = self.layers[-1]
         if not isinstance(out_layer, OutputLayer):
             raise ValueError("Last layer must be an OutputLayer/RnnOutputLayer for fit()")
-        pre, _, new_state, new_carries = self._forward(
-            params, net_state, x, train=train, rng=rng, fmask=fmask, carries=carries
+        pre, acts, new_state, new_carries = self._forward(
+            params, net_state, x, train=train, rng=rng, fmask=fmask,
+            carries=carries, collect=collect_acts
         )
         if self.conf.compute_dtype is not None:
             pre = pre.astype(jnp.float32)  # loss in full precision
@@ -190,6 +198,17 @@ class MultiLayerNetwork(LazyScoreMixin):
         for layer in self.layers:
             if layer.has_params():
                 reg = reg + layer.reg_score(params[layer.name])
+        if collect_acts:
+            # introspection: summarize every layer's activations while
+            # they are still live in the graph (reduced to [A] scalars
+            # immediately — the full activations are never carried out)
+            from deeplearning4j_tpu.observability import introspection
+
+            policy = self.conf.introspection
+            act_stats = introspection.act_summary(
+                list(zip((l.name for l in self.layers), acts)),
+                dead_eps=policy.dead_eps if policy is not None else 0.0)
+            return data_loss + reg, (new_state, new_carries, act_stats)
         return data_loss + reg, (new_state, new_carries)
 
     # ------------------------------------------------------------ train step
@@ -203,17 +222,26 @@ class MultiLayerNetwork(LazyScoreMixin):
         and net state likewise) — zero host syncs, zero recompiles
         (resilience/stability.py).  ``stability=None`` keeps the exact
         pre-guard trace."""
+        from deeplearning4j_tpu.observability import introspection
+
         updater_cfg = self.conf.updater
         policy = self.conf.stability
+        plan = introspection.plan_for(self)
         lr_overrides = {
             l.name: l.learning_rate for l in self.layers if l.learning_rate is not None
         }
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fmask, lmask, carries):
+            if plan is not None:
+                _, upd_state = introspection.split_state(upd_state)
+            kw = ({"collect_acts": True}
+                  if plan is not None and plan.collect_acts else {})
             if policy is None:
-                (loss, (new_net_state, new_carries)), grads = jax.value_and_grad(
+                (loss, aux), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True
-                )(params, net_state, x, y, rng, fmask, lmask, carries)
+                )(params, net_state, x, y, rng, fmask, lmask, carries, **kw)
+                new_net_state, new_carries, act_stats = (
+                    introspection.unpack_aux(plan, aux))
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_upd_state = upd.update(
                     updater_cfg, grads, upd_state, iteration, lr_overrides,
@@ -222,18 +250,29 @@ class MultiLayerNetwork(LazyScoreMixin):
                 new_params = dict(params)
                 for lname, u in updates.items():
                     new_params[lname] = upd.apply_updates(params[lname], u)
+                introspection.attach(
+                    new_upd_state, plan, grads=grads, params=params,
+                    new_params=new_params, iteration=iteration,
+                    act_stats=act_stats)
                 return new_params, new_upd_state, new_net_state, loss, new_carries
             from deeplearning4j_tpu.resilience import stability
 
             stab, inner = stability.split_state(upd_state)
-            (_, (loss, (new_net_state, new_carries))), grads = (
+            (_, (loss, aux)), grads = (
                 jax.value_and_grad(
                     stability.scaled_loss(self._loss_fn, stab), has_aux=True
-                )(params, net_state, x, y, rng, fmask, lmask, carries))
+                )(params, net_state, x, y, rng, fmask, lmask, carries, **kw))
+            new_net_state, new_carries, act_stats = (
+                introspection.unpack_aux(plan, aux))
             new_params, new_upd_state, new_net_state, finite = (
                 stability.apply_guarded_update(
                     policy, updater_cfg, stab, inner, params, net_state,
                     loss, grads, new_net_state, iteration, lr_overrides))
+            # grads here are loss-scaled; norms unscale exactly
+            introspection.attach(
+                new_upd_state, plan, grads=grads, params=params,
+                new_params=new_params, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
             if new_carries is not None and policy.skip_nonfinite:
                 # a poisoned TBPTT window must not smuggle NaN hidden
                 # state into the next window: reset the stream instead
@@ -296,6 +335,11 @@ class MultiLayerNetwork(LazyScoreMixin):
             # 'semantically identical to fit' promise above
             raise ValueError("fit_scanned requires num_iterations == 1 "
                              f"(got {self.conf.num_iterations})")
+        if self.conf.introspection is not None:
+            from deeplearning4j_tpu.observability import introspection
+
+            introspection.ensure_state(self)
+            self._introspect_live = None
         scanned = self._jit_cache.setdefault(
             "scanned_step", self._make_scanned_step())
         step = self._get_train_step()
@@ -393,6 +437,14 @@ class MultiLayerNetwork(LazyScoreMixin):
                 # a restored nonfinite_total is history, not fresh evidence
                 self._stab_rt.baseline_from(
                     self.updater_state.get(stability.STATE_KEY))
+        if self.conf.introspection is not None:
+            from deeplearning4j_tpu.observability import introspection
+
+            introspection.ensure_state(self)
+            # the facade's updater_state is authoritative during a solo
+            # fit; a stale per-replica stamp from an earlier master run
+            # must not shadow it
+            self._introspect_live = None
         try:
             if labels is not None:
                 batches = [(data, labels, fmask, lmask)]

@@ -341,7 +341,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         n_kv_heads: Optional[int] = None,
                         window: Optional[int] = None,
                         max_cache: int = 1024,
-                        stability=None) -> MultiLayerNetwork:
+                        stability=None,
+                        introspection=None) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
@@ -370,6 +371,10 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
         # training-stability engine (nn.conf.TrainingStability): the
         # non-finite guard + loss scaling the production loops run with
         nb.training_stability(stability)
+    if introspection is not None:
+        # training-introspection engine (nn.conf.TrainingIntrospection):
+        # per-layer gradient/update/activation stats inside the step
+        nb.training_introspection(introspection)
     b = nb.list()
     if compute_dtype:
         b.compute_dtype(compute_dtype)

@@ -135,6 +135,45 @@ class TrainingStability:
 
 
 @dataclasses.dataclass(frozen=True)
+class TrainingIntrospection:
+    """Training-introspection policy (engine:
+    ``observability/introspection.py``).
+
+    The reference's headline observability feature was the web training
+    UI fed by ``StatsListener``: per-layer weight/gradient/update/
+    activation statistics — the diagnostics that catch vanishing or
+    exploding gradients, dead units, and mistuned learning rates before
+    a run is wasted.  This policy enables the one-XLA-program version:
+    per-layer gradient norm, update norm, update:param ratio, and
+    activation summaries (mean/std/fraction-zero) are computed INSIDE
+    the jitted train step as one fused reduction pass per leaf, carried
+    in a reserved ``__introspect__`` subtree of the updater state (the
+    ``__stability__`` pattern) so they stack per replica, shard, donate,
+    and checkpoint — zero host syncs on non-report steps, one batched
+    device->host transfer per reporting interval, zero recompiles.
+
+    ``collect_activations``: also summarize every layer's training
+    activations (mean / std / fraction-zero for dead-unit detection).
+    ``dead_eps``: an activation counts as "dead" when ``|a| <= dead_eps``
+    (0.0 = exact zeros, the ReLU case).
+    """
+
+    collect_activations: bool = True
+    dead_eps: float = 0.0
+
+    def __post_init__(self):
+        if self.dead_eps < 0:
+            raise ValueError(f"dead_eps must be >= 0, got {self.dead_eps}")
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return TrainingIntrospection(**d)
+
+
+@dataclasses.dataclass(frozen=True)
 class MultiLayerConfiguration:
     """Completed, immutable network config (reference
     ``nn/conf/MultiLayerConfiguration.java``)."""
@@ -158,6 +197,9 @@ class MultiLayerConfiguration:
     # training-stability engine (non-finite step guard, loss scaling,
     # divergence sentinel) — None keeps the exact pre-stability trace
     stability: Optional[TrainingStability] = None
+    # training-introspection engine (device-side per-layer gradient/
+    # update/activation statistics) — None keeps the exact prior trace
+    introspection: Optional[TrainingIntrospection] = None
 
     def __post_init__(self):
         # guard every construction path (builder, from_dict, direct): an
@@ -185,6 +227,8 @@ class MultiLayerConfiguration:
             "backprop": self.backprop,
             "compute_dtype": self.compute_dtype,
             "stability": self.stability.to_dict() if self.stability else None,
+            "introspection": (self.introspection.to_dict()
+                              if self.introspection else None),
         }
 
     def to_json(self) -> str:
@@ -208,6 +252,8 @@ class MultiLayerConfiguration:
             compute_dtype=d.get("compute_dtype"),
             stability=(TrainingStability.from_dict(d["stability"])
                        if d.get("stability") else None),
+            introspection=(TrainingIntrospection.from_dict(d["introspection"])
+                           if d.get("introspection") else None),
         )
 
     @staticmethod
@@ -322,6 +368,7 @@ class ListBuilder:
             backprop=self._backprop,
             compute_dtype=self._compute_dtype,
             stability=p._stability,
+            introspection=p._introspection,
         )
 
 
@@ -343,6 +390,7 @@ class Builder:
         self._dropout: Optional[float] = None
         self._regularization = False
         self._stability: Optional[TrainingStability] = None
+        self._introspection: Optional[TrainingIntrospection] = None
 
     def seed(self, s: int) -> "Builder":
         self._seed = int(s)
@@ -402,6 +450,31 @@ class Builder:
             raise ValueError(
                 f"training_stability expects True/False/TrainingStability, "
                 f"got {policy!r}")
+        return self
+
+    def training_introspection(self, policy=True, **kwargs) -> "Builder":
+        """Enable the training-introspection engine (device-side
+        per-layer gradient/update/activation statistics — see
+        ``TrainingIntrospection`` / docs/observability.md "Training
+        introspection").  Pass a ``TrainingIntrospection``, keyword
+        overrides, or ``False`` to disable::
+
+            .training_introspection(collect_activations=False)
+        """
+        if policy is False or policy is None:
+            if kwargs:
+                raise ValueError(
+                    "training_introspection(False) takes no kwargs")
+            self._introspection = None
+        elif isinstance(policy, TrainingIntrospection):
+            self._introspection = (dataclasses.replace(policy, **kwargs)
+                                   if kwargs else policy)
+        elif policy is True:
+            self._introspection = TrainingIntrospection(**kwargs)
+        else:
+            raise ValueError(
+                f"training_introspection expects True/False/"
+                f"TrainingIntrospection, got {policy!r}")
         return self
 
     def optimization_algo(self, algo: str) -> "Builder":

@@ -43,6 +43,9 @@ from deeplearning4j_tpu.observability.flightrecorder import (
     dump_flight_report, get_flight_recorder, get_watchdog,
     read_flight_report, set_flight_recorder, step_guard,
 )
+from deeplearning4j_tpu.observability.introspection import (
+    AnomalyMonitor, IntrospectPlan,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
@@ -60,4 +63,5 @@ __all__ = [
     "FlightEvent", "FlightRecorder", "StepWatchdog", "crash_dump",
     "dump_flight_report", "get_flight_recorder", "get_watchdog",
     "read_flight_report", "set_flight_recorder", "step_guard",
+    "AnomalyMonitor", "IntrospectPlan",
 ]

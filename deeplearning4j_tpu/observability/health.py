@@ -387,6 +387,20 @@ class HealthRule:
       (``dl4j_divergence_rewinds_total``) must be <= ``limit``: every
       auto-rewind re-trains from an older checkpoint; repeated rewinds
       mean the run cannot make it past a divergence wall
+    - ``max_dead_fraction`` — max gauge child
+      (``dl4j_layer_dead_fraction``) must be <= ``limit``: a layer whose
+      activations are (nearly) all zero is a dying-ReLU / dead-unit
+      layer; the failing layer is named in the detail
+      (docs/observability.md "Training introspection")
+    - ``update_ratio_band`` — every ``dl4j_layer_update_ratio`` gauge
+      child must lie in ``[limit_low, limit]``: the update:param norm
+      ratio doctrine (~1e-3 healthy) — too low means the layer is
+      frozen/vanishing, too high means the LR is about to bounce the
+      weights; the worst offender is named
+    - ``max_gradient_norm_ratio`` — the max:min spread over
+      ``dl4j_layer_gradient_norm`` children must be <= ``limit``:
+      vanishing/exploding gradients across depth, with both extreme
+      layers named
     - ``predicate`` — ``fn(extra) -> bool`` (or ``(ok, observed, detail)``)
       for liveness checks that live outside the registry
 
@@ -405,22 +419,33 @@ class HealthRule:
         "max_evicted_replicas": "dl4j_elastic_evicted_replicas",
         "max_nonfinite_steps": "dl4j_nonfinite_steps_total",
         "max_divergence_rewinds": "dl4j_divergence_rewinds_total",
+        "max_dead_fraction": "dl4j_layer_dead_fraction",
+        "update_ratio_band": "dl4j_layer_update_ratio",
+        "max_gradient_norm_ratio": "dl4j_layer_gradient_norm",
     }
 
     def __init__(self, name: str, kind: str, limit: Optional[float] = None,
                  metric: Optional[str] = None,
                  labels: Optional[Dict[str, str]] = None,
                  require_data: bool = False,
-                 fn: Optional[Callable[[Any], Any]] = None):
+                 fn: Optional[Callable[[Any], Any]] = None,
+                 limit_low: Optional[float] = None):
         if kind != "predicate" and kind not in self._DEFAULT_METRIC:
             raise ValueError(f"unknown health-rule kind {kind!r}")
         if kind == "predicate" and fn is None:
             raise ValueError("predicate rules need fn=")
         if kind != "predicate" and limit is None:
             raise ValueError(f"rule {name!r} ({kind}) needs limit=")
+        if kind == "update_ratio_band":
+            if limit_low is None:
+                raise ValueError("update_ratio_band needs limit_low=")
+            if limit_low > limit:
+                raise ValueError(
+                    f"limit_low {limit_low} > limit {limit}")
         self.name = name
         self.kind = kind
         self.limit = limit
+        self.limit_low = limit_low
         self.metric = metric or self._DEFAULT_METRIC.get(kind)
         self.labels = dict(labels or {})
         self.require_data = require_data
@@ -452,7 +477,7 @@ class HealthRule:
             return v, f"worst child: {labels or 'unlabeled'}"
         if self.kind in ("max_queue_depth", "min_throughput",
                          "max_checkpoint_staleness",
-                         "max_evicted_replicas"):
+                         "max_evicted_replicas", "max_dead_fraction"):
             vals = [(c.value, labels) for labels, c in children]
             vals = [(v, l) for v, l in vals if not math.isnan(v)]
             if not vals:
@@ -461,14 +486,40 @@ class HealthRule:
             # depth cap, best current throughput for the floor (a stale
             # low gauge from a finished side model must not fail the
             # floor forever — narrow with labels= to watch one child),
-            # the stalest checkpoint manager for the staleness cap, and
-            # the most-degraded component for the eviction budget
+            # the stalest checkpoint manager for the staleness cap, the
+            # most-degraded component for the eviction budget, and the
+            # most-dead layer for the dead-unit cap
             v, labels = max(vals, key=lambda t: t[0])
             which = {"max_queue_depth": "deepest",
                      "min_throughput": "best",
                      "max_checkpoint_staleness": "stalest",
-                     "max_evicted_replicas": "most degraded"}[self.kind]
+                     "max_evicted_replicas": "most degraded",
+                     "max_dead_fraction": "most dead"}[self.kind]
             return v, f"{which} child: {labels or 'unlabeled'}"
+        if self.kind == "update_ratio_band":
+            vals = [(c.value, labels) for labels, c in children
+                    if not math.isnan(c.value)]
+            if not vals:
+                return None, "no gauge children yet"
+
+            def badness(v):
+                # multiplicative distance outside [limit_low, limit];
+                # <= 1 means inside the band
+                if v <= 0:
+                    return math.inf
+                return max(self.limit_low / v, v / self.limit)
+
+            v, labels = max(vals, key=lambda t: badness(t[0]))
+            return v, f"worst child: {labels or 'unlabeled'}"
+        if self.kind == "max_gradient_norm_ratio":
+            vals = [(c.value, labels) for labels, c in children
+                    if math.isfinite(c.value) and c.value > 0]
+            if len(vals) < 2:
+                return None, "fewer than two layers with gradient norms"
+            lo_v, lo_l = min(vals, key=lambda t: t[0])
+            hi_v, hi_l = max(vals, key=lambda t: t[0])
+            return hi_v / lo_v, (f"max {hi_l or 'unlabeled'}={hi_v:.3g} vs "
+                                 f"min {lo_l or 'unlabeled'}={lo_v:.3g}")
         # counters: sum over matching children
         if not children:
             return None, "counter not registered yet"
@@ -498,6 +549,8 @@ class HealthRule:
                 "required -> fail" if self.require_data else "pass")
         elif self.kind == "min_throughput":
             ok = observed >= self.limit
+        elif self.kind == "update_ratio_band":
+            ok = self.limit_low <= observed <= self.limit
         else:
             ok = observed <= self.limit
         return {"name": self.name, "kind": self.kind, "ok": ok,
@@ -551,6 +604,9 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
                            max_evicted_replicas: Optional[float] = None,
                            max_nonfinite_steps: Optional[float] = None,
                            max_divergence_rewinds: Optional[float] = None,
+                           max_dead_fraction: Optional[float] = None,
+                           update_ratio_band=None,
+                           max_gradient_norm_ratio: Optional[float] = None,
                            ) -> List[HealthRule]:
     """Sensible defaults for a training process: an optional step-time
     SLO, an optional throughput floor, a recompile budget (steady-state
@@ -559,9 +615,12 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
     CheckpointManager stopped committing fails /health while the progress
     is still recoverable — docs/resilience.md), an optional evicted-
     replica budget (degraded-mode training past the budget fails /health
-    even though the loop is still making progress), and optional
+    even though the loop is still making progress), optional
     stability budgets: guarded-skip steps and divergence auto-rewinds
-    (docs/resilience.md "Stability")."""
+    (docs/resilience.md "Stability"), and optional introspection anomaly
+    budgets: dead-unit fraction cap, update:param ratio band
+    ``(low, high)``, and cross-layer gradient-norm spread
+    (docs/observability.md "Training introspection")."""
     rules = [HealthRule("recompile_budget", "max_recompiles",
                         max_recompiles)]
     if max_step_p99_s is not None:
@@ -586,6 +645,20 @@ def default_training_rules(max_step_p99_s: Optional[float] = None,
         rules.append(HealthRule("divergence_rewinds",
                                 "max_divergence_rewinds",
                                 max_divergence_rewinds))
+    # training-introspection anomaly budgets (per-layer gradient/update/
+    # activation gauges published by StatsListener harvests —
+    # docs/observability.md "Training introspection")
+    if max_dead_fraction is not None:
+        rules.append(HealthRule("dead_fraction", "max_dead_fraction",
+                                max_dead_fraction))
+    if update_ratio_band is not None:
+        lo, hi = update_ratio_band
+        rules.append(HealthRule("update_ratio_band", "update_ratio_band",
+                                hi, limit_low=lo))
+    if max_gradient_norm_ratio is not None:
+        rules.append(HealthRule("gradient_norm_ratio",
+                                "max_gradient_norm_ratio",
+                                max_gradient_norm_ratio))
     return rules
 
 

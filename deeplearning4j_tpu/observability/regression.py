@@ -109,6 +109,12 @@ DEFAULT_RULES: List[Rule] = [
     Rule("Stability guarded step", direction=LOWER, tolerance=0.4),
     Rule("Stability guarded step", field="recovery_ms", direction=LOWER,
          tolerance=1.0, required=False),
+    # training introspection (bench_introspection): the stats-on fit step
+    # must not drift slower — the per-layer reductions are fused into the
+    # XLA step and the harvest is one batched transfer per 10th step, so
+    # a collapse here means the collection fell off the fused path (or a
+    # per-report host-sync storm came back).
+    Rule("Introspected train step", direction=LOWER, tolerance=0.4),
 ]
 
 

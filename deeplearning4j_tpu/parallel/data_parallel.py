@@ -222,9 +222,12 @@ class ParallelWrapper:
         return NamedSharding(self.mesh, P(backend.AXIS_DATA))
 
     def _build(self):
+        from deeplearning4j_tpu.observability import introspection
+
         net = self.net
         cfg = net.conf.updater
         policy = net.conf.stability
+        plan = introspection.plan_for(net)
         lr_overrides = {
             l.name: l.learning_rate for l in net.layers if l.learning_rate is not None
         }
@@ -232,16 +235,27 @@ class ParallelWrapper:
         average_updaters = self.average_updaters
 
         def one_replica_step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
+            if plan is not None:
+                _, upd_state = introspection.split_state(upd_state)
+            kw = ({"collect_acts": True}
+                  if plan is not None and plan.collect_acts else {})
             if policy is None:
-                (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
-                    params, net_state, x, y, rng, fm, lm, None
+                (loss, aux), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
+                    params, net_state, x, y, rng, fm, lm, None, **kw
                 )
+                new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration,
                                              lr_overrides, params=params)
                 new_params = dict(params)
                 for lname, u in updates.items():
                     new_params[lname] = upd.apply_updates(params[lname], u)
+                # vmapped: each replica refreshes its own [L] slice, so
+                # the window exits with a [K, L] per-replica view
+                introspection.attach(
+                    new_us, plan, grads=grads, params=params,
+                    new_params=new_params, iteration=iteration,
+                    act_stats=act_stats)
                 return new_params, new_us, new_ns, loss, jnp.ones(())
             # non-finite step guard per replica (resilience/stability.py):
             # a poisoned replica's step is a device-side no-op; the window
@@ -249,13 +263,18 @@ class ParallelWrapper:
             from deeplearning4j_tpu.resilience import stability
 
             stab, inner = stability.split_state(upd_state)
-            (_, (loss, (new_ns, _))), grads = jax.value_and_grad(
+            (_, (loss, aux)), grads = jax.value_and_grad(
                 stability.scaled_loss(net._loss_fn, stab), has_aux=True)(
-                params, net_state, x, y, rng, fm, lm, None)
+                params, net_state, x, y, rng, fm, lm, None, **kw)
+            new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
             new_params, new_us, new_ns, finite = (
                 stability.apply_guarded_update(
                     policy, cfg, stab, inner, params, net_state,
                     loss, grads, new_ns, iteration, lr_overrides))
+            introspection.attach(
+                new_us, plan, grads=grads, params=params,
+                new_params=new_params, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
             return new_params, new_us, new_ns, loss, finite.astype(jnp.float32)
 
         vstep = jax.vmap(one_replica_step, in_axes=(0, 0, 0, None, 0, 0, 0, 0, 0))
@@ -306,7 +325,18 @@ class ParallelWrapper:
             params_k = jax.tree_util.tree_map(wavg, params_k)
             ns_k = jax.tree_util.tree_map(wavg, ns_k)
             if average_updaters:
-                upd_k = jax.tree_util.tree_map(wavg, upd_k)
+                if plan is not None and introspection.STATE_KEY in upd_k:
+                    # the introspection subtree is the PER-REPLICA view —
+                    # averaging it would erase exactly the per-replica
+                    # divergence signal it exists to expose
+                    intro_k = upd_k[introspection.STATE_KEY]
+                    rest = {k: v for k, v in upd_k.items()
+                            if k != introspection.STATE_KEY}
+                    rest = jax.tree_util.tree_map(wavg, rest)
+                    rest[introspection.STATE_KEY] = intro_k
+                    upd_k = rest
+                else:
+                    upd_k = jax.tree_util.tree_map(wavg, upd_k)
             if policy is not None:
                 return (params_k, upd_k, ns_k, losses,
                         1.0 - win_finite, jnp.sum(1.0 - finites))
@@ -357,6 +387,13 @@ class ParallelWrapper:
                     "parallel_wrapper", policy,
                     worker_ids=[str(k) for k in range(K)])
         stab_rt = self._stab_rt
+        introspect = getattr(net.conf, "introspection", None) is not None
+        if introspect:
+            from deeplearning4j_tpu.observability import introspection
+
+            # introspection state must exist BEFORE replica stacking so
+            # the per-layer stat vectors ride in upd_k as [K, L]
+            introspection.ensure_state(net)
         params_k = _stack_tree(net.params, K)
         upd_k = _stack_tree(net.updater_state, K)
         ns_k = _stack_tree(net.net_state, K)
@@ -480,6 +517,30 @@ class ParallelWrapper:
                         ns_k = _stack_tree(net.net_state, K)
                         if net.net_state:
                             ns_k = jax.device_put(ns_k, shard)
+            if introspect:
+                from deeplearning4j_tpu.observability import introspection
+
+                # stacked [K, L] per-replica view for harvesters — a
+                # device reference only, no transfer until a listener's
+                # reporting interval actually reads it
+                net._introspect_live = upd_k.get(introspection.STATE_KEY)
+            if net.listeners:
+                # fire the facade's listeners once per averaging window
+                # (reference ParallelWrapper notifies per iteration) with
+                # the averaged state folded back — device-side slices,
+                # no host sync unless a listener reads a value
+                from deeplearning4j_tpu.models.common import notify_listeners
+
+                self._fold_back(net, params_k, upd_k, ns_k, it, last_losses)
+                # sample count excludes pad-filled tail slots (each zero
+                # in pad_w is one duplicated/zero-filled minibatch slot)
+                # so listener throughput reflects REAL examples; pad_w is
+                # a host-built numpy [K] vector (_pad_weights), no sync
+                real_slots = n_batches - (
+                    0 if pad_w is None else int((pad_w == 0.0).sum()))
+                notify_listeners(
+                    net, real_slots
+                    * (int(xs.shape[2]) if xs.ndim >= 3 else 1))
             self._phases.steps += 1
             if res is not None and res.cm is not None:
                 trigger = res.cm.due(it)

@@ -1022,14 +1022,31 @@ class PipelineParallelTrainingMaster(TrainingMaster):
 
     # ---------------------------------------------------------------- train
     def execute_training(self, net, iterator):
-        from deeplearning4j_tpu.resilience import (
-            FitResilience, preemption_requested,
-        )
+        from deeplearning4j_tpu.resilience import FitResilience
 
         res = None
         if self.checkpoint_manager is not None or self.retry_policy is not None:
             res = FitResilience("pipeline_master", self.checkpoint_manager,
                                 self.retry_policy, net=net)
+        intro_held = None
+        if getattr(net.conf, "introspection", None) is not None:
+            # the pipeline master splits updater state per stage by LAYER
+            # name; the layerless __introspect__ subtree cannot shard that
+            # way, so introspection does not cover this master yet — park
+            # the subtree for the duration of the fit instead of feeding
+            # it into the per-stage split (docs/observability.md)
+            from deeplearning4j_tpu.observability import introspection
+
+            intro_held = net.updater_state.pop(introspection.STATE_KEY, None)
+        try:
+            return self._execute_with_master(net, iterator, res)
+        finally:
+            if intro_held is not None:
+                net.updater_state[introspection.STATE_KEY] = intro_held
+
+    def _execute_with_master(self, net, iterator, res):
+        from deeplearning4j_tpu.resilience import preemption_requested
+
         if not self._built:
             self._build(net)
         if self._mode == "compiled":

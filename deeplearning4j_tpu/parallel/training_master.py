@@ -181,10 +181,12 @@ class SyncTrainingMaster(TrainingMaster):
         return NamedSharding(self.mesh, P())
 
     def _build(self, net):
+        from deeplearning4j_tpu.observability import introspection
         from deeplearning4j_tpu.resilience import stability
 
         cfg = net.conf.updater
         policy = net.conf.stability
+        plan = introspection.plan_for(net)
         lr_overrides = {
             l.name: l.learning_rate for l in net.layers if l.learning_rate is not None
         }
@@ -195,11 +197,12 @@ class SyncTrainingMaster(TrainingMaster):
         players = self._param_layout(net)
         # updater state mirrors the param tree per slot ({"m": ..., "v": ...})
         # but only over TRAINABLE layers — restrict to the state's own keys.
-        # The stability subtree is plain scalars (loss scale, counters):
-        # replicated, like the rest of the non-param step state.
+        # The stability and introspection subtrees are plain scalars/small
+        # vectors: replicated, like the rest of the non-param step state.
         if isinstance(players, dict) and net.updater_state:
             ulayers: Any = {
-                slot: (repl if slot == stability.STATE_KEY
+                slot: (repl if slot in (stability.STATE_KEY,
+                                        introspection.STATE_KEY)
                        else {ln: players[ln] for ln in tree})
                 for slot, tree in net.updater_state.items()
             }
@@ -209,10 +212,15 @@ class SyncTrainingMaster(TrainingMaster):
             ulayers = players
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
+            if plan is not None:
+                _, upd_state = introspection.split_state(upd_state)
+            kw = ({"collect_acts": True}
+                  if plan is not None and plan.collect_acts else {})
             if policy is None:
-                (loss, (new_ns, _)), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
-                    params, net_state, x, y, rng, fm, lm, None
+                (loss, aux), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
+                    params, net_state, x, y, rng, fm, lm, None, **kw
                 )
+                new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration,
                                              lr_overrides, params=params)
@@ -221,6 +229,13 @@ class SyncTrainingMaster(TrainingMaster):
                          if (u := updates.get(ln)) else params[ln])
                     for ln in params
                 }
+                # the gradients here are already the all-reduced global
+                # mean, so the per-layer norms are the cluster-wide view
+                # (replicated across devices)
+                introspection.attach(
+                    new_us, plan, grads=grads, params=params,
+                    new_params=new_params, iteration=iteration,
+                    act_stats=act_stats)
                 return new_params, new_us, new_ns, loss
             # stability engine (resilience/stability.py): poisoned ROWS are
             # zeroed before the forward (NaN activations poison the
@@ -238,9 +253,10 @@ class SyncTrainingMaster(TrainingMaster):
             y = stability.zero_nonfinite_rows(y, row_ok)
             lm = lm * row_ok.reshape((row_ok.shape[0],)
                                      + (1,) * (lm.ndim - 1))
-            (_, (loss, (new_ns, _))), grads = jax.value_and_grad(
+            (_, (loss, aux)), grads = jax.value_and_grad(
                 stability.scaled_loss(net._loss_fn, stab), has_aux=True)(
-                params, net_state, x, y, rng, fm, lm, None)
+                params, net_state, x, y, rng, fm, lm, None, **kw)
+            new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
             # an all-rows-poisoned batch yields a zero loss and zero
             # gradients — finite, but updating would still decay Adam
             # moments toward the pad; veto it
@@ -248,6 +264,10 @@ class SyncTrainingMaster(TrainingMaster):
                 policy, cfg, stab, inner, params, net_state, loss, grads,
                 new_ns, iteration, lr_overrides,
                 extra_ok=jnp.sum(row_ok) > 0)
+            introspection.attach(
+                new_us, plan, grads=grads, params=params,
+                new_params=new_params, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
             return (new_params, new_us, new_ns, loss,
                     stability.slot_poison_flags(row_ok, K))
 
@@ -300,6 +320,14 @@ class SyncTrainingMaster(TrainingMaster):
                 self._stab_rt.baseline_from(
                     net.updater_state.get(stability.STATE_KEY))
         stab_rt = self._stab_rt
+        introspect = getattr(net.conf, "introspection", None) is not None
+        if introspect:
+            from deeplearning4j_tpu.observability import introspection
+
+            # introspection state must exist BEFORE _build/device
+            # placement so the stat vectors ride in upd_state (replicated
+            # under _upd_layout)
+            introspection.ensure_state(net)
         if self._step is None:
             self._build(net)
         params = jax.device_put(net.params, self._params_layout)
@@ -391,6 +419,11 @@ class SyncTrainingMaster(TrainingMaster):
                         stab_rt.accumulate(poison_flags=slot_poison)
                     else:
                         params, upd_state, ns, loss = out
+            if introspect:
+                # live device reference for listeners (the facade's
+                # updater_state is stale until the loop exits); no
+                # transfer until a reporting interval reads it
+                net._introspect_live = upd_state[introspection.STATE_KEY]
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             if stab_rt is not None:
@@ -451,6 +484,14 @@ class SyncTrainingMaster(TrainingMaster):
                 self._elastic.window_barrier(step0)
             self._stats["steps"] += 1
             self._phases.steps += 1
+            if net.listeners:
+                # listeners read model.params/updater_state; the facade's
+                # stale references point at buffers the jitted step
+                # DONATED — rebind to the live step outputs (reference
+                # assignment only, no copy; the loop-exit fold-back does
+                # exactly this)
+                net.params, net.updater_state, net.net_state = (
+                    params, upd_state, ns)
             notify_listeners(net, n_real)
         net.params, net.updater_state, net.net_state = params, upd_state, ns
         if stab_rt is not None:

@@ -14,10 +14,13 @@ listener POSTs StatsReport JSON to ``/collect``.
 from __future__ import annotations
 
 import json
+import math
+import queue as queue_mod
 import threading
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Dict, List, Optional
 
 from deeplearning4j_tpu.observability.health import (
     HealthEvaluator, default_training_rules,
@@ -26,6 +29,37 @@ from deeplearning4j_tpu.observability.metrics import get_registry
 from deeplearning4j_tpu.optimize.listeners import IterationListener
 from deeplearning4j_tpu.ui.stats import StatsReport, StatsUpdateConfiguration
 from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage, StatsStorage
+
+# metric selectors the comparison / drill-down endpoints understand:
+# plain report fields, or "<kind>:<layer>" per-layer introspection series
+_REPORT_METRICS = {"score", "iteration_time_ms", "samples_per_second"}
+_LAYER_METRICS = {
+    "gradient_norm": ("gradient_stats", "norm"),
+    "update_norm": ("update_stats", "norm"),
+    "update_ratio": ("update_stats", "ratio"),
+    "param_norm": ("update_stats", "param_norm"),
+    "dead_fraction": ("activation_stats", "zero_fraction"),
+    "activation_mean": ("activation_stats", "mean"),
+    "activation_std": ("activation_stats", "std"),
+}
+
+
+def _metric_value(u: StatsReport, metric: str):
+    """One report's value for a metric selector, or None."""
+    if metric in _REPORT_METRICS:
+        v = getattr(u, metric)
+        return v if v is not None and not (isinstance(v, float)
+                                           and math.isnan(v)) else None
+    kind, _, layer = metric.partition(":")
+    spec = _LAYER_METRICS.get(kind)
+    if spec is None or not layer:
+        raise ValueError(f"unknown metric '{metric}'")
+    entry = (getattr(u, spec[0]) or {}).get(layer)
+    if not entry:
+        return None
+    v = entry.get(spec[1])
+    return v if v is not None and not (isinstance(v, float)
+                                       and math.isnan(v)) else None
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu training UI</title>
@@ -74,6 +108,17 @@ async function refresh(){
       html += lineChart('score vs iteration', data.iterations, data.scores);
     if(data.iteration_times.length>1)
       html += lineChart('iteration time (ms)', data.iterations, data.iteration_times);
+    const intro = await (await fetch('train/introspection?sid='+sid)).json();
+    for(const layer of (intro.layers||[]).slice(0,6)){
+      const s = intro.series[layer]||{};
+      const g=s.gradient_norm, r=s.update_ratio, d=s.dead_fraction;
+      if(g && g.values.length>1)
+        html += lineChart('gradient norm: '+layer, g.iterations, g.values);
+      if(r && r.values.length>1)
+        html += lineChart('update:param ratio: '+layer, r.iterations, r.values);
+      if(d && d.values.some(v=>v>0))
+        html += lineChart('dead fraction: '+layer, d.iterations, d.values);
+    }
     const latest = data.latest_histograms || {};
     for(const k of Object.keys(latest).slice(0,8)){
       html += histChart('param histogram: '+k, latest[k].bins, latest[k].counts);
@@ -82,6 +127,17 @@ async function refresh(){
   document.getElementById('root').innerHTML = html || 'no sessions yet';
 }
 refresh(); setInterval(refresh, 3000);
+// live view: any SSE update triggers an immediate redraw (polling stays
+// as the fallback when EventSource is unavailable)
+try{
+  let pending = false;
+  const es = new EventSource('train/stream');
+  es.onmessage = () => {
+    if(pending) return;
+    pending = true;
+    setTimeout(() => { pending = false; refresh(); }, 250);
+  };
+}catch(e){}
 </script></body></html>
 """
 
@@ -111,6 +167,140 @@ class UIServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._requested_port = port
+        # set on stop(): live SSE handler threads poll it between
+        # heartbeats so shutdown never waits on an open stream
+        self._stopping = threading.Event()
+
+    # ------------------------------------------------------------- queries
+    def compare_sessions(self, sids: List[str],
+                         metric: str = "score") -> Dict[str, Any]:
+        """Overlay N sessions' series by iteration — the run-comparison
+        view (same LR sweep, before/after a fix, replica A vs B).
+        ``metric``: a report field (``score``, ``iteration_time_ms``,
+        ``samples_per_second``) or ``<kind>:<layer>`` with kind one of
+        gradient_norm / update_norm / update_ratio / param_norm /
+        dead_fraction / activation_mean / activation_std."""
+        if metric not in _REPORT_METRICS:
+            kind, _, layer = metric.partition(":")
+            if kind not in _LAYER_METRICS or not layer:
+                raise ValueError(f"unknown metric '{metric}'")
+        sessions: Dict[str, Any] = {}
+        for sid in sids:
+            its, vals = [], []
+            for u in self.storage.get_updates(sid):
+                v = _metric_value(u, metric)
+                if v is None:
+                    continue
+                its.append(u.iteration)
+                vals.append(v)
+            sessions[sid] = {"iterations": its, "values": vals}
+        return {"metric": metric, "sessions": sessions}
+
+    def layer_detail(self, sid: str, layer: str) -> Dict[str, Any]:
+        """Per-layer drill-down as a UI component tree
+        (``ui.components``): gradient/update-norm, update:param ratio,
+        activation mean/std, dead fraction — per-replica series when the
+        session ran under a data-parallel master — plus the layer's
+        latest param histograms."""
+        from deeplearning4j_tpu.ui.components import (
+            ChartHistogram, ChartLine, ComponentDiv, ComponentTable,
+        )
+
+        ups = self.storage.get_updates(sid)
+        div = ComponentDiv()
+
+        def series_chart(title, metric):
+            chart = ChartLine(title)
+            its, vals = [], []
+            for u in ups:
+                v = _metric_value(u, f"{metric}:{layer}")
+                if v is not None:
+                    its.append(u.iteration)
+                    vals.append(v)
+            if its:
+                chart.add_series(metric, its, vals)
+            return chart, bool(its)
+
+        for title, metric in (("gradient norm", "gradient_norm"),
+                              ("update norm", "update_norm"),
+                              ("update:param ratio", "update_ratio"),
+                              ("activation mean", "activation_mean"),
+                              ("activation std", "activation_std"),
+                              ("dead fraction", "dead_fraction")):
+            chart, has = series_chart(f"{layer}: {title}", metric)
+            if has:
+                div.children.append(chart)
+        # per-replica gradient-norm overlay (wrapper runs)
+        per_rep = ChartLine(f"{layer}: per-replica gradient norm")
+        n_rep = 0
+        for u in ups:
+            entry = (u.gradient_stats or {}).get(layer) or {}
+            n_rep = max(n_rep, len(entry.get("per_replica") or ()))
+        for k in range(n_rep):
+            its, vals = [], []
+            for u in ups:
+                col = ((u.gradient_stats or {}).get(layer) or {}).get(
+                    "per_replica")
+                if col is not None and k < len(col) \
+                        and math.isfinite(col[k]):
+                    its.append(u.iteration)
+                    vals.append(col[k])
+            if its:
+                per_rep.add_series(f"replica {k}", its, vals)
+        if per_rep.series:
+            div.children.append(per_rep)
+        if ups:
+            last = ups[-1]
+            for name, h in (last.param_histograms or {}).items():
+                if not name.startswith(f"{layer}/"):
+                    continue
+                hist = ChartHistogram(f"param histogram: {name}")
+                for lo, hi, c in zip(h["bins"][:-1], h["bins"][1:],
+                                     h["counts"]):
+                    hist.add_bin(lo, hi, c)
+                div.children.append(hist)
+            rows = []
+            for metric in _LAYER_METRICS:
+                v = _metric_value(last, f"{metric}:{layer}")
+                if v is not None:
+                    rows.append((metric, f"{v:.6g}"))
+            if rows:
+                div.children.append(
+                    ComponentTable(["stat", "latest"], rows))
+        return div.to_dict()
+
+    def introspection_series(self, sid: str) -> Dict[str, Any]:
+        """All per-layer introspection series of one session (feeds the
+        dashboard's layer charts)."""
+        ups = self.storage.get_updates(sid)
+        layers: List[str] = []
+        for u in ups:
+            for name in (u.gradient_stats or {}):
+                if name not in layers:
+                    layers.append(name)
+            for name in (u.activation_stats or {}):
+                if name not in layers:
+                    layers.append(name)
+        out: Dict[str, Any] = {"layers": layers, "series": {}}
+        for layer in layers:
+            entry: Dict[str, Any] = {}
+            for m in _LAYER_METRICS:
+                # per-metric iteration axis: a NaN/absent value (e.g. a
+                # guarded no-op step's ratio) is SKIPPED, never emitted
+                # as null — a shared axis would force null padding and
+                # crash/skew the dashboard's chart renderer
+                its: List[int] = []
+                vals: List[float] = []
+                for u in ups:
+                    v = _metric_value(u, f"{m}:{layer}")
+                    if v is None:
+                        continue
+                    its.append(u.iteration)
+                    vals.append(v)
+                if vals:
+                    entry[m] = {"iterations": its, "values": vals}
+            out["series"][layer] = entry
+        return out
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> int:
@@ -129,10 +319,78 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _sse(self, sid: Optional[str], replay: bool) -> None:
+                """Server-Sent-Events live stream of StatsReport updates
+                (``sid=`` filters to one session; ``replay=1`` first
+                replays the stored history, so a late-attaching client —
+                or a post-crash reopen of a FileStatsStorage — sees the
+                whole run).  Heartbeats every second keep dead-client
+                detection prompt; the stream ends on client disconnect
+                or server stop."""
+                q: "queue_mod.Queue" = queue_mod.Queue(maxsize=1024)
+
+                def on_update(rep):
+                    if sid and rep.session_id != sid:
+                        return
+                    try:
+                        q.put_nowait(rep)
+                    except queue_mod.Full:
+                        pass   # slow client: drop rather than block training
+
+                storage.add_listener(on_update)
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.end_headers()
+                    if replay:
+                        sids = [sid] if sid else storage.list_session_ids()
+                        for s in sids:
+                            for rep in storage.get_updates(s):
+                                self._event(rep)
+                    while not ui._stopping.is_set():
+                        try:
+                            rep = q.get(timeout=1.0)
+                        except queue_mod.Empty:
+                            self.wfile.write(b": keep-alive\n\n")
+                            self.wfile.flush()
+                            continue
+                        self._event(rep)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass   # client went away — normal stream teardown
+                finally:
+                    storage.remove_listener(on_update)
+
+            def _event(self, rep) -> None:
+                self.wfile.write(b"data: " + rep.to_json().encode()
+                                 + b"\n\n")
+                self.wfile.flush()
+
             def do_GET(self):
                 path, _, query = self.path.partition("?")
-                params = dict(p.split("=", 1) for p in query.split("&") if "=" in p)
-                if path in ("/", "/train", "/train/"):
+                params = {k: urllib.parse.unquote(v) for k, v in
+                          (p.split("=", 1) for p in query.split("&")
+                           if "=" in p)}
+                if path.endswith("/train/stream") or path == "/stream":
+                    self._sse(params.get("sid"),
+                              params.get("replay") in ("1", "true"))
+                elif path.endswith("/train/compare") or path == "/compare":
+                    sids = [s for s in params.get("sids", "").split(",") if s]
+                    try:
+                        self._json(ui.compare_sessions(
+                            sids, params.get("metric", "score")))
+                    except ValueError as e:
+                        self._json({"error": str(e)}, 400)
+                elif path.endswith("/train/layer"):
+                    sid, layer = params.get("sid"), params.get("layer")
+                    if not sid or not layer:
+                        self._json({"error": "sid= and layer= required"},
+                                   400)
+                    else:
+                        self._json(ui.layer_detail(sid, layer))
+                elif path.endswith("/train/introspection"):
+                    self._json(ui.introspection_series(params.get("sid")))
+                elif path in ("/", "/train", "/train/"):
                     body = _PAGE.encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/html")
@@ -184,6 +442,7 @@ class UIServer:
                 else:
                     self._json({"error": "not found"}, 404)
 
+        self._stopping.clear()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", self._requested_port),
                                           Handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
@@ -196,6 +455,7 @@ class UIServer:
         return self._httpd.server_address[1] if self._httpd else None
 
     def stop(self) -> None:
+        self._stopping.set()   # unblock live SSE streams within one beat
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -15,9 +15,10 @@ Device memory comes from PJRT ``memory_stats()`` instead of JVM MX beans.
 
 from __future__ import annotations
 
+import itertools
 import json
 import time
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from functools import partial
 from typing import Any, Dict, List, Optional
 
@@ -30,6 +31,15 @@ from deeplearning4j_tpu.observability.memory import (
     sample_once as _sample_device_memory,
 )
 from deeplearning4j_tpu.optimize.listeners import IterationListener
+
+# Monotonic per-process suffix for generated session ids: two listeners
+# created in the same millisecond must NOT silently interleave their
+# reports into one session (the old ms-timestamp ids collided).
+_SESSION_SEQ = itertools.count()
+
+
+def _new_session_id(prefix: str) -> str:
+    return f"{prefix}_{int(time.time() * 1000)}_{next(_SESSION_SEQ)}"
 
 
 @dataclass
@@ -45,6 +55,12 @@ class StatsUpdateConfiguration:
     collect_histograms_activations: bool = False
     collect_mean_magnitudes: bool = True
     num_histogram_bins: int = 20
+    # training introspection (device-side per-layer gradient/update/
+    # activation stats, docs/observability.md): harvested into the
+    # report when the model's conf enables it; anomaly_detection runs
+    # the AnomalyMonitor rules on each harvested report
+    collect_introspection: bool = True
+    anomaly_detection: bool = True
 
 
 @dataclass
@@ -79,6 +95,14 @@ class StatsReport:
     update_histograms: Dict[str, Any] = field(default_factory=dict)
     param_stats: Dict[str, Any] = field(default_factory=dict)
     learning_rate: float = float("nan")
+    # training introspection (device-computed, one transfer per report):
+    # per-layer {"norm", ["per_replica"]}, {"norm", "ratio",
+    # "param_norm"}, {"mean", "std", "zero_fraction"}; replicas is the
+    # data-parallel replica count when the stats are per-replica
+    gradient_stats: Dict[str, Any] = field(default_factory=dict)
+    update_stats: Dict[str, Any] = field(default_factory=dict)
+    activation_stats: Dict[str, Any] = field(default_factory=dict)
+    replicas: Optional[int] = None
 
     def to_json(self) -> str:
         return json.dumps({"type": "update", **asdict(self)})
@@ -87,7 +111,15 @@ class StatsReport:
     def from_json(s: str) -> "StatsReport":
         d = json.loads(s)
         d.pop("type", None)
-        return StatsReport(**d)
+        return StatsReport.from_dict(d)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "StatsReport":
+        # forward-compatible: fields a NEWER writer added are dropped
+        # instead of raising, so mixed-version FileStatsStorage files
+        # stay readable
+        known = {f.name for f in fields(StatsReport)}
+        return StatsReport(**{k: v for k, v in d.items() if k in known})
 
 
 @partial(jax.jit, static_argnums=(1,))
@@ -104,20 +136,59 @@ def _summary_and_histogram(flat, bins):
     return mn, mx, mean, std, mean_mag, edges, counts
 
 
-def _tensor_stats(tree, bins: int) -> Dict[str, Any]:
-    out = {}
+@partial(jax.jit, static_argnums=(1,))
+def _summary_stack(flats, bins):
+    """ALL leaves' summaries in one device program: [N, 5] summary rows
+    (min/max/mean/std/mean-magnitude), [N, bins+1] edges, [N, bins]
+    counts — stacked so the caller pulls everything with ONE host
+    transfer per report (the old per-tensor path paid five scalar
+    ``float()`` syncs plus two ``np.asarray`` pulls per tensor)."""
+    rows, edges, counts = [], [], []
+    for flat in flats:
+        flat = flat.astype(jnp.float32)
+        mn, mx, mean, std, mm, e, c = _summary_and_histogram.__wrapped__(
+            flat, bins)
+        rows.append(jnp.stack([mn, mx, mean, std, mm]))
+        edges.append(e)
+        counts.append(c)
+    return jnp.stack(rows), jnp.stack(edges), jnp.stack(counts)
+
+
+def _leaf_entries(tree):
+    """(name, flat array) per param leaf, walking nested subtrees
+    (composite layers) in sorted key order."""
+    out = []
+
+    def walk(prefix, t):
+        if isinstance(t, dict):
+            for k in sorted(t):
+                walk(prefix + (str(k),), t[k])
+        elif t is not None:
+            out.append(("/".join(prefix), jnp.ravel(jnp.asarray(t))))
+
     for layer, params in tree.items():
-        if not params:
-            continue
-        for pname, arr in params.items():
-            flat = jnp.ravel(arr)
-            mn, mx, mean, std, mm, edges, counts = _summary_and_histogram(flat, bins)
-            out[f"{layer}/{pname}"] = {
-                "min": float(mn), "max": float(mx), "mean": float(mean),
-                "stdev": float(std), "mean_magnitude": float(mm),
-                "bins": np.asarray(edges).tolist(),
-                "counts": np.asarray(counts).tolist(),
-            }
+        if params:
+            walk((str(layer),), params)
+    return out
+
+
+def _tensor_stats(tree, bins: int) -> Dict[str, Any]:
+    entries = _leaf_entries(tree)
+    if not entries:
+        return {}
+    names = [n for n, _ in entries]
+    rows, edges, counts = _summary_stack(tuple(f for _, f in entries), bins)
+    # the report's single batched device->host transfer
+    rows, edges, counts = jax.device_get((rows, edges, counts))
+    out = {}
+    for i, name in enumerate(names):
+        mn, mx, mean, std, mm = (float(v) for v in rows[i])
+        out[name] = {
+            "min": mn, "max": mx, "mean": mean, "stdev": std,
+            "mean_magnitude": mm,
+            "bins": [float(v) for v in edges[i]],
+            "counts": [int(v) for v in counts[i]],
+        }
     return out
 
 
@@ -137,11 +208,12 @@ class StatsListener(IterationListener):
 
     def __init__(self, storage, session_id: Optional[str] = None,
                  config: Optional[StatsUpdateConfiguration] = None,
-                 registry=None):
+                 registry=None, anomaly_monitor=None):
         self.storage = storage
-        self.session_id = session_id or f"session_{int(time.time() * 1000)}"
+        self.session_id = session_id or _new_session_id("session")
         self.config = config or StatsUpdateConfiguration()
         self.registry = registry
+        self._anomaly = anomaly_monitor   # lazily defaulted on first use
         self._last_time: Optional[float] = None
         self._initialized = False
 
@@ -202,7 +274,31 @@ class StatsListener(IterationListener):
                 k: {"mean_magnitude": v["mean_magnitude"]}
                 for k, v in (rep.param_histograms or _tensor_stats(
                     model.params, cfg.num_histogram_bins)).items()}
+        if cfg.collect_introspection:
+            self._collect_introspection(model, rep, iteration)
         self.storage.put_update(rep)
+
+    def _collect_introspection(self, model, rep: StatsReport,
+                               iteration: int) -> None:
+        """Harvest the device-side introspection subtree (one batched
+        transfer), extend the report, mirror the dl4j_layer_* gauges,
+        and run the anomaly rules.  A model without
+        ``conf.introspection`` contributes nothing."""
+        from deeplearning4j_tpu.observability import introspection
+
+        harvested = introspection.harvest_model(model)
+        if harvested is None:
+            return
+        rep.gradient_stats = harvested["gradient_stats"]
+        rep.update_stats = harvested["update_stats"]
+        rep.activation_stats = harvested["activation_stats"]
+        rep.replicas = harvested["replicas"]
+        introspection.publish_metrics(harvested, registry=self.registry)
+        if self.config.anomaly_detection:
+            if self._anomaly is None:
+                self._anomaly = introspection.AnomalyMonitor(
+                    component=type(model).__name__)
+            self._anomaly.check(harvested, iteration=iteration)
 
 
 class HistogramIterationListener(StatsListener):
@@ -223,7 +319,7 @@ class FlowIterationListener(IterationListener):
     def __init__(self, storage, session_id: Optional[str] = None,
                  frequency: int = 10):
         self.storage = storage
-        self.session_id = session_id or f"flow_{int(time.time() * 1000)}"
+        self.session_id = session_id or _new_session_id("flow")
         self.frequency = frequency
 
     def iteration_done(self, model, iteration: int) -> None:

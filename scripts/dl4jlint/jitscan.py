@@ -123,6 +123,14 @@ def _scan_nodes(nodes: List[ast.AST]) -> JitScan:
                 if (dotted_name(dec) in _JIT_NAMES
                         or (isinstance(dec, ast.Call) and is_jit_call(dec))):
                     traced_nodes.append(node)
+                    # the decorated NAME is a jitted callable too: a loop
+                    # invoking it per iteration is a hot loop (the old
+                    # per-tensor StatsListener sync storm hid behind this
+                    # gap — decorator-jitted helpers driven from a Python
+                    # loop never registered as jitted symbols)
+                    static = (has_static_args(dec)
+                              if isinstance(dec, ast.Call) else False)
+                    scan.jitted_symbols.setdefault(node.name, static)
         if is_jit_call(node):
             scan.jit_calls.append(node)
             # partial(jax.jit, f): traced arg is args[1]; jax.jit(f): args[0]

@@ -76,6 +76,12 @@ def pytest_configure(config):
         "non-finite step guard, loss scaling, divergence sentinel with "
         "auto-rewind, per-replica poison masking "
         "(python -m pytest -m stability)")
+    config.addinivalue_line(
+        "markers",
+        "introspect: training-introspection tests — device-side "
+        "per-layer gradient/update/activation stats, anomaly rules, "
+        "SSE/run-comparison UI endpoints, crash-safe stats storage "
+        "(python -m pytest -m introspect)")
 
 
 def pytest_collection_modifyitems(config, items):

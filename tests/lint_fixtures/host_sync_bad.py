@@ -23,3 +23,14 @@ def fit_loop(batches, params):
         total += np.asarray(out).sum()        # per-step device readback
         out.block_until_ready()               # per-step pipeline stall
     return total
+
+
+def per_tensor_stats(tree):
+    # the StatsListener sync storm: a loop driving a DECORATED jit
+    # helper with a per-tensor host pull (fixed in ui/stats.py — the
+    # decorated name must register as a jitted symbol for this to flag)
+    out = {}
+    for name, arr in tree.items():
+        summary = decorated_step(arr, arr)
+        out[name] = np.asarray(summary).tolist()   # per-tensor readback
+    return out

@@ -1,0 +1,529 @@
+"""Training-introspection layer (docs/observability.md "Training
+introspection"): device-side per-layer gradient/update/activation
+statistics inside the jitted train step, StatsListener harvest into
+extended StatsReports, anomaly rules naming the offending layer, SSE /
+run-comparison UI endpoints, and crash-safe FileStatsStorage.
+
+Acceptance oracles (ISSUE 12):
+
+- a guarded fit with introspection enabled is BIT-IDENTICAL to an
+  introspection-off run with zero recompiles after the first step;
+- an injected dying-ReLU layer (large negative bias) is named by layer
+  in a dead_fraction health-rule violation + flight event;
+- a 4-replica ParallelWrapper run exposes per-replica gradient-norm
+  series, and the SSE stream + run-comparison endpoint replay them live
+  and post-hoc from a FileStatsStorage reopened after a simulated crash.
+"""
+
+import http.client
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+from deeplearning4j_tpu.models.graph import ComputationGraph
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    NeuralNetConfiguration, TrainingIntrospection,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observability import (
+    AnomalyMonitor, HealthRule, get_flight_recorder, get_registry,
+    introspection,
+)
+from deeplearning4j_tpu.parallel import (
+    DistributedNetwork, ParallelWrapper, SyncTrainingMaster,
+)
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener, StatsReport,
+    StatsUpdateConfiguration, UIServer,
+)
+
+pytestmark = pytest.mark.introspect
+
+
+def counter_value(name, **labels):
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for label_pairs, child in fam.samples():
+        d = dict(label_pairs)
+        if all(d.get(k) == v for k, v in labels.items()):
+            total += child.value
+    return total
+
+
+def flight_events(kind, **attrs):
+    out = []
+    for ev in get_flight_recorder().events():
+        if ev.kind != kind:
+            continue
+        if all(ev.attrs.get(k) == v for k, v in attrs.items()):
+            out.append(ev)
+    return out
+
+
+def make_net(seed=1, intro=True, stab=False, activation="tanh",
+             updater="adam"):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater, learning_rate=0.01))
+    if intro:
+        b.training_introspection()
+    if stab:
+        b.training_stability()
+    conf = (b.list()
+            .layer(DenseLayer(n_in=6, n_out=10, activation=activation))
+            .layer(OutputLayer(n_in=10, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def batch(seed=0, n=24):
+    rs = np.random.RandomState(seed)
+    x = rs.rand(n, 6).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    return x, y
+
+
+# ----------------------------------------------------- device-side collection
+
+def test_bit_identical_and_zero_recompiles_guarded():
+    """Acceptance: guarded (stability) fit with introspection on is
+    bit-identical to introspection-off, with zero recompiles after the
+    first step."""
+    x, y = batch()
+    on = make_net(intro=True, stab=True)
+    off = make_net(intro=False, stab=True)
+    on.fit(x, y)   # first step compiles
+    off.fit(x, y)
+    compiles0 = counter_value("dl4j_compiles_total")
+    recompiles0 = counter_value("dl4j_recompiles_total")
+    for _ in range(6):
+        on.fit(x, y)
+        off.fit(x, y)
+    assert counter_value("dl4j_compiles_total") == compiles0
+    assert counter_value("dl4j_recompiles_total") == recompiles0
+    for a, b in zip(jax.tree_util.tree_leaves(on.params),
+                    jax.tree_util.tree_leaves(off.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    h = introspection.harvest_model(on)
+    assert h["iteration"] == on.iteration - 1
+    assert all(np.isfinite(e["norm"]) and e["norm"] > 0
+               for e in h["gradient_stats"].values())
+
+
+def test_unguarded_collection_and_ratio():
+    x, y = batch()
+    net = make_net(intro=True, stab=False)
+    for _ in range(4):
+        net.fit(x, y)
+    h = introspection.harvest_model(net)
+    assert set(h["gradient_stats"]) == {"layer_0", "layer_1"}
+    for e in h["update_stats"].values():
+        assert e["norm"] > 0 and e["param_norm"] > 0
+        assert abs(e["ratio"] - e["norm"] / e["param_norm"]) < 1e-9
+    assert h["replicas"] is None
+    for e in h["activation_stats"].values():
+        assert np.isfinite(e["mean"]) and np.isfinite(e["std"])
+
+
+def test_graph_facade_collection():
+    from deeplearning4j_tpu.models.graph import GraphBuilder
+
+    p = NeuralNetConfiguration.builder().seed(3).updater(
+        "adam", learning_rate=0.01)
+    p.training_introspection()
+    gb = GraphBuilder(p)
+    conf = (gb.add_inputs("in")
+            .add_layer("dense", DenseLayer(n_in=6, n_out=8,
+                                           activation="relu"), "in")
+            .add_layer("out", OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                                          activation="softmax"), "dense")
+            .set_outputs("out")
+            .build())
+    net = ComputationGraph(conf).init()
+    x, y = batch()
+    for _ in range(3):
+        net.fit(x, y)
+    h = introspection.harvest_model(net)
+    assert set(h["gradient_stats"]) == {"dense", "out"}
+    assert "dense" in h["activation_stats"]
+
+
+def test_conf_serde_roundtrip_and_model_save(tmp_path):
+    net = make_net(intro=True)
+    d = net.conf.to_json()
+    from deeplearning4j_tpu.nn.conf import MultiLayerConfiguration
+
+    back = MultiLayerConfiguration.from_json(d)
+    assert back.introspection == TrainingIntrospection()
+    x, y = batch()
+    net.fit(x, y)
+    p = str(tmp_path / "model.zip")
+    net.save(p)
+    loaded = MultiLayerNetwork.load(p)
+    assert introspection.STATE_KEY in loaded.updater_state
+    # the checkpointed stats travel with the updater state
+    assert np.array_equal(
+        np.asarray(loaded.updater_state[introspection.STATE_KEY]["packed"]),
+        np.asarray(net.updater_state[introspection.STATE_KEY]["packed"]))
+    loaded.fit(x, y)   # and the restored net keeps training + collecting
+    assert introspection.harvest_model(loaded)["iteration"] == 1
+
+
+# --------------------------------------------------------------- dead units
+
+def test_dying_relu_named_in_rule_and_flight_event():
+    """Acceptance: a large negative bias on a ReLU layer is named by
+    layer in a dead_fraction health-rule violation + flight event."""
+    net = make_net(seed=7, intro=True, activation="relu")
+    # inject the dying layer: bias so negative every pre-activation < 0
+    net.params["layer_0"]["b"] = (
+        net.params["layer_0"]["b"] - 100.0)
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, session_id="dying"))
+    x, y = batch(seed=7)
+    for _ in range(3):
+        net.fit(x, y)
+    rep = storage.get_latest_update("dying")
+    assert rep.activation_stats["layer_0"]["zero_fraction"] > 0.99
+    # flight event names the layer
+    evs = flight_events("introspection_anomaly", rule="max_dead_fraction",
+                        layer="layer_0")
+    assert evs, "no introspection_anomaly flight event for layer_0"
+    # the health-rule kind reads the published gauge and names the layer
+    verdict = HealthRule("dead", "max_dead_fraction", 0.5).evaluate(
+        get_registry())
+    assert not verdict["ok"]
+    assert "layer_0" in verdict["detail"]
+
+
+def test_anomaly_monitor_update_ratio_and_spread():
+    mon = AnomalyMonitor(band_low=1e-3, band_high=1e-1,
+                         max_gradient_norm_ratio=10.0, warn_interval_s=0.0)
+    harvested = {
+        "iteration": 5,
+        "gradient_stats": {"a": {"norm": 100.0}, "b": {"norm": 1.0}},
+        "update_stats": {"a": {"norm": 1.0, "param_norm": 1.0,
+                               "ratio": 1.0},      # above band
+                         "b": {"norm": 1e-6, "param_norm": 1.0,
+                               "ratio": 1e-6}},    # below band
+        "activation_stats": {},
+    }
+    rules = {(v["rule"], v["layer"]) for v in mon.check(harvested)}
+    assert ("update_ratio_band", "a") in rules
+    assert ("update_ratio_band", "b") in rules
+    assert ("max_gradient_norm_ratio", "b") in rules  # names the min layer
+    # a skipped (no-op) step is not evidence
+    harvested["update_stats"]["a"]["ratio"] = 0.0
+    assert ("update_ratio_band", "a") not in {
+        (v["rule"], v["layer"]) for v in mon.check(harvested)}
+
+
+def test_update_ratio_band_health_rule():
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()   # isolated: the global one has live layers
+    g = reg.gauge("dl4j_layer_update_ratio",
+                  "Per-layer update:param norm ratio (test reuse)",
+                  labels=("layer",))
+    g.set(1e-3, layer="healthy_x")
+    g.set(0.9, layer="bouncy_x")
+    rule = HealthRule("band", "update_ratio_band", 0.1, limit_low=1e-5)
+    verdict = rule.evaluate(reg)
+    assert not verdict["ok"]
+    assert "bouncy_x" in verdict["detail"]
+    g.set(1e-3, layer="bouncy_x")
+    assert rule.evaluate(reg)["ok"]
+    # a frozen layer (ratio 0) fails the band too
+    g.set(0.0, layer="frozen_x")
+    verdict = rule.evaluate(reg)
+    assert not verdict["ok"] and "frozen_x" in verdict["detail"]
+    # gradient-norm spread rule names both extremes
+    gn = reg.gauge("dl4j_layer_gradient_norm", "test", labels=("layer",))
+    gn.set(100.0, layer="top_x")
+    gn.set(1e-6, layer="bottom_x")
+    verdict = HealthRule("spread", "max_gradient_norm_ratio",
+                         1e3).evaluate(reg)
+    assert not verdict["ok"]
+    assert "top_x" in verdict["detail"] and "bottom_x" in verdict["detail"]
+
+
+# --------------------------------------------------------------- parallel
+
+def test_parallel_wrapper_per_replica_series():
+    """Acceptance: a 4-replica ParallelWrapper run exposes per-replica
+    gradient-norm series."""
+    K = 4
+    mesh = backend.default_mesh(data=K, devices=jax.devices()[:K])
+    net = make_net(seed=11, intro=True)
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, session_id="pw"))
+    rs = np.random.RandomState(1)
+    feats = rs.rand(64, 6).astype(np.float32)
+    labs = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 64)]
+    recompiles0 = counter_value("dl4j_recompiles_total")
+    pw = ParallelWrapper(net, workers=K, averaging_frequency=1, mesh=mesh)
+    pw.fit(iter(ListDataSetIterator(DataSet(feats, labs), 8)))
+    assert counter_value("dl4j_recompiles_total") == recompiles0
+    ups = storage.get_updates("pw")
+    assert len(ups) >= 2          # one report per averaging window
+    for rep in ups:
+        assert rep.replicas == K
+        pr = rep.gradient_stats["layer_0"]["per_replica"]
+        assert len(pr) == K and all(np.isfinite(v) for v in pr)
+    # replicas see different shards -> different per-replica norms
+    assert len({round(v, 9) for v in
+                ups[0].gradient_stats["layer_0"]["per_replica"]}) > 1
+
+
+def test_sync_master_collection():
+    K = 4
+    mesh = backend.default_mesh(data=K, devices=jax.devices()[:K])
+    net = make_net(seed=13, intro=True)
+    storage = InMemoryStatsStorage()
+    net.set_listeners(StatsListener(storage, session_id="sm"))
+    rs = np.random.RandomState(2)
+    feats = rs.rand(32, 6).astype(np.float32)
+    labs = np.eye(3, dtype=np.float32)[rs.randint(0, 3, 32)]
+    m = SyncTrainingMaster(mesh=mesh)
+    DistributedNetwork(net, m).fit(
+        ListDataSetIterator(DataSet(feats, labs), 16))
+    ups = storage.get_updates("sm")
+    assert len(ups) == 2
+    # the sync-master gradient is the all-reduced global mean: one
+    # cluster-wide (replicated) value per layer, no per-replica axis
+    assert ups[-1].replicas is None
+    assert ups[-1].gradient_stats["layer_0"]["norm"] > 0
+
+
+# ------------------------------------------------------------ report serde
+
+def test_stats_report_serde_roundtrip_new_fields():
+    rep = StatsReport(
+        session_id="s", iteration=3, timestamp=1.5, score=0.25,
+        learning_rate=0.01,   # explicit: default NaN breaks == on purpose
+        gradient_stats={"l0": {"norm": 0.5, "per_replica": [0.4, 0.6]}},
+        update_stats={"l0": {"norm": 0.01, "ratio": 2e-3,
+                             "param_norm": 5.0}},
+        activation_stats={"l0": {"mean": 0.1, "std": 0.2,
+                                 "zero_fraction": 0.3}},
+        replicas=2)
+    back = StatsReport.from_json(rep.to_json())
+    assert back == rep
+    # forward compat: unknown fields from a newer writer are dropped
+    d = json.loads(rep.to_json())
+    d["field_from_the_future"] = {"x": 1}
+    tolerant = StatsReport.from_json(json.dumps(d))
+    assert tolerant == rep
+
+
+# ------------------------------------------------------------ file storage
+
+def _fill_storage(path, n=3):
+    storage = FileStatsStorage(path)
+    net = make_net(seed=5, intro=True)
+    net.set_listeners(StatsListener(storage, session_id="filed"))
+    x, y = batch(seed=5)
+    for _ in range(n):
+        net.fit(x, y)
+    return storage
+
+
+def test_file_storage_reload_equals_memory(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    storage = _fill_storage(p)
+    reloaded = FileStatsStorage(p)
+    assert reloaded.list_session_ids() == storage.list_session_ids()
+    mem, disk = storage.get_updates("filed"), reloaded.get_updates("filed")
+    assert len(disk) == len(mem)
+    for a, b in zip(mem, disk):
+        # field-wise (== would trip on the NaN learning_rate default)
+        assert (a.iteration, a.score, a.gradient_stats, a.update_stats,
+                a.activation_stats, a.param_histograms) == \
+               (b.iteration, b.score, b.gradient_stats, b.update_stats,
+                b.activation_stats, b.param_histograms)
+    assert reloaded.get_init_report("filed") is not None
+
+
+def test_file_storage_torn_tail_recovered(tmp_path):
+    """Satellite: a torn trailing JSONL line (killed writer) must not
+    lose the history — skip/truncate with a warning, and the file keeps
+    accepting appends afterwards."""
+    p = str(tmp_path / "stats.jsonl")
+    storage = _fill_storage(p)
+    n_good = len(storage.get_updates("filed"))
+    with open(p, "ab") as f:   # simulate a writer killed mid-record
+        f.write(b'{"type": "update", "session_id": "filed", "iter')
+    reloaded = FileStatsStorage(p)   # must NOT raise
+    assert len(reloaded.get_updates("filed")) == n_good
+    # the torn tail was truncated: a new append produces a valid file
+    reloaded.put_update(StatsReport(session_id="filed", iteration=99,
+                                    timestamp=time.time()))
+    again = FileStatsStorage(p)
+    ups = again.get_updates("filed")
+    assert len(ups) == n_good + 1 and ups[-1].iteration == 99
+
+
+def test_file_storage_missing_final_newline_kept(tmp_path):
+    p = str(tmp_path / "stats.jsonl")
+    FileStatsStorage(p).put_update(StatsReport(
+        session_id="s", iteration=1, timestamp=0.0))
+    with open(p, "r+b") as f:   # full record, cut newline
+        f.seek(0, 2)
+        f.truncate(f.tell() - 1)
+    reloaded = FileStatsStorage(p)
+    assert len(reloaded.get_updates("s")) == 1
+    reloaded.put_update(StatsReport(session_id="s", iteration=2,
+                                    timestamp=0.0))
+    assert len(FileStatsStorage(p).get_updates("s")) == 2
+
+
+def test_session_id_no_collision():
+    ids = {StatsListener(InMemoryStatsStorage()).session_id
+           for _ in range(50)}
+    assert len(ids) == 50
+
+
+# ------------------------------------------------------------- UI server
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def _sse_collect(port, path, want, timeout_s=15.0):
+    """Read SSE events until ``want`` data lines arrived (or timeout)."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout_s)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    events = []
+    deadline = time.time() + timeout_s
+    while len(events) < want and time.time() < deadline:
+        line = resp.fp.readline()
+        if not line:
+            break
+        if line.startswith(b"data: "):
+            events.append(json.loads(line[6:].decode()))
+    conn.close()
+    return events
+
+
+def test_sse_and_compare_under_concurrent_writers(tmp_path):
+    """Satellite + acceptance: SSE live stream and the run-comparison
+    endpoint under concurrent writers, replayed post-hoc from a
+    FileStatsStorage reopened after a simulated crash."""
+    p = str(tmp_path / "stats.jsonl")
+    storage = FileStatsStorage(p)
+    server = UIServer(storage)
+    port = server.start()
+    try:
+        n_each = 6
+
+        def writer(sid, seed):
+            net = make_net(seed=seed, intro=True)
+            net.set_listeners(StatsListener(storage, session_id=sid))
+            x, y = batch(seed=seed)
+            for _ in range(n_each):
+                net.fit(x, y)
+
+        # live SSE client attaches BEFORE the writers start
+        got = {}
+        t_sse = threading.Thread(
+            target=lambda: got.setdefault("events", _sse_collect(
+                port, "/train/stream", want=2 * n_each)),
+            daemon=True)
+        t_sse.start()
+        time.sleep(0.3)
+        threads = [threading.Thread(target=writer, args=(sid, seed))
+                   for sid, seed in (("run_a", 21), ("run_b", 22))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        t_sse.join(timeout=20)
+        events = got.get("events") or []
+        sids = {e["session_id"] for e in events}
+        assert {"run_a", "run_b"} <= sids
+        assert len(events) >= 2 * n_each
+
+        # run comparison overlays both sessions by iteration
+        cmp_ = _get_json(
+            port, "/train/compare?sids=run_a,run_b&metric=score")
+        assert set(cmp_["sessions"]) == {"run_a", "run_b"}
+        for s in cmp_["sessions"].values():
+            assert len(s["iterations"]) == n_each
+        layer_cmp = _get_json(
+            port,
+            "/train/compare?sids=run_a,run_b&metric=gradient_norm:layer_0")
+        assert all(len(s["values"]) == n_each
+                   for s in layer_cmp["sessions"].values())
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get_json(port, "/train/compare?sids=a&metric=nope:x")
+        assert exc.value.code == 400
+
+        # per-layer drill-down renders a component tree
+        detail = _get_json(port, "/train/layer?sid=run_a&layer=layer_0")
+        assert detail["componentType"] == "ComponentDiv"
+        titles = [c.get("title", "") for c in detail["components"]]
+        assert any("gradient norm" in t for t in titles)
+    finally:
+        server.stop()
+
+    # simulated crash: torn tail appended, storage reopened — post-hoc
+    # replay must serve the full history through BOTH endpoints
+    with open(p, "ab") as f:
+        f.write(b'{"type": "update", "session_id": "run_a"')
+    reopened = FileStatsStorage(p)
+    server2 = UIServer(reopened)
+    port2 = server2.start()
+    try:
+        cmp2 = _get_json(
+            port2, "/train/compare?sids=run_a,run_b&metric=score")
+        assert all(len(s["values"]) == n_each
+                   for s in cmp2["sessions"].values())
+        replay = _sse_collect(
+            port2, "/train/stream?sid=run_a&replay=1", want=n_each,
+            timeout_s=10)
+        assert len(replay) == n_each
+        assert [e["iteration"] for e in replay] == sorted(
+            e["iteration"] for e in replay)
+    finally:
+        server2.stop()
+
+
+def test_introspection_series_endpoint():
+    storage = InMemoryStatsStorage()
+    server = UIServer(storage)
+    port = server.start()
+    try:
+        net = make_net(seed=31, intro=True)
+        net.set_listeners(StatsListener(storage, session_id="ser"))
+        x, y = batch(seed=31)
+        for _ in range(4):
+            net.fit(x, y)
+        series = _get_json(port, "/train/introspection?sid=ser")
+        assert "layer_0" in series["layers"]
+        s = series["series"]["layer_0"]
+        assert len(s["gradient_norm"]["values"]) == 4
+        assert s["gradient_norm"]["iterations"] == [1, 2, 3, 4]
+        assert len(s["update_ratio"]["values"]) == 4
+        # no nulls anywhere: every emitted point is chartable
+        for entry in s.values():
+            assert all(v is not None and np.isfinite(v)
+                       for v in entry["values"])
+    finally:
+        server.stop()
