@@ -1,0 +1,23 @@
+"""Continuous-batching autoregressive serving with a paged KV cache.
+
+The decode analog of ``deeplearning4j_tpu/serving/``: requests join and
+leave a RUNNING decode batch at every step (iteration-level scheduling,
+Orca/vLLM), KV state lives in fixed-size pages addressed through int32
+block tables (closed XLA shape set, zero steady-state recompiles),
+identical prompt prefixes share refcounted pages, and the serving model
+hot-swaps between decode steps with zero dropped streams.  See
+docs/serving.md ("Generation").
+"""
+
+from deeplearning4j_tpu.generation.engine import (      # noqa: F401
+    DEFAULT_MODEL, GenerationEngine,
+)
+from deeplearning4j_tpu.generation.paged_cache import (  # noqa: F401
+    PagedKVCache, PageExhaustedError,
+)
+from deeplearning4j_tpu.generation.programs import (     # noqa: F401
+    GenerationPrograms, seed_paged_pools,
+)
+from deeplearning4j_tpu.generation.scheduler import (    # noqa: F401
+    DecodeScheduler, GenerationRequest,
+)

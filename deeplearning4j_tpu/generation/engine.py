@@ -1,0 +1,402 @@
+"""GenerationEngine: continuous-batching autoregressive serving.
+
+Ties the pieces together into the decode analog of the PR-2
+``ServingEngine``:
+
+- a ``PagedKVCache`` + ``DecodeScheduler`` (iteration-level batching:
+  requests join/leave the RUNNING batch every step; prefix sharing;
+  admission control with 429/503/504 instead of hangs),
+- a ``ModelRegistry`` (named/versioned models; ``deploy`` is a
+  zero-drop hot-swap BETWEEN decode steps — in-flight streams keep
+  their KV and continue under the new weights, which is the standard
+  weight-only-update serving semantic),
+- per-version ``GenerationPrograms`` (bucketed prefill + one decode
+  step, AOT-warmed through the version's RecompileDetector before it
+  serves: zero steady-state compiles),
+- a ``GenerationMetrics`` bundle and ``step_guard`` spans (decode steps
+  are visible to the StepProfiler/watchdog like any train step).
+
+One background decode thread owns the device pools, the slot arrays,
+and the page allocator; clients only touch the admission queue and
+their own request handles, so ``submit``/``stream`` are thread-safe.
+
+Minimal use::
+
+    engine = GenerationEngine(net, slots=8, page_size=16,
+                              max_context=128)
+    engine.start()                      # AOT-warms every program
+    h = engine.submit([1, 2, 3], max_new_tokens=16)
+    for tok in h.stream(): ...          # tokens as they decode
+    engine.deploy("default", new_net)   # hot-swap between steps
+    engine.stop()
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.generation.paged_cache import PagedKVCache
+from deeplearning4j_tpu.generation.programs import GenerationPrograms
+from deeplearning4j_tpu.generation.scheduler import (
+    DecodeScheduler, GenerationRequest,
+)
+from deeplearning4j_tpu.observability.flightrecorder import (
+    get_flight_recorder, step_guard,
+)
+from deeplearning4j_tpu.observability.servingmetrics import GenerationMetrics
+from deeplearning4j_tpu.observability.tracing import get_tracer
+from deeplearning4j_tpu.serving.admission import ModelNotFoundError
+from deeplearning4j_tpu.serving.buckets import _pow2_buckets
+from deeplearning4j_tpu.serving.registry import ModelRegistry, ModelVersion
+
+logger = logging.getLogger("deeplearning4j_tpu.generation")
+
+DEFAULT_MODEL = "default"
+
+# finish reasons that count as a successful completion
+_OK_REASONS = ("length", "stop")
+
+
+class GenerationEngine:
+    """See module docstring."""
+
+    def __init__(self, model=None, *, slots: int = 8, page_size: int = 16,
+                 max_context: int = 256, num_pages: Optional[int] = None,
+                 max_queue: int = 64, deadline_s: float = 60.0,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 models: Optional[ModelRegistry] = None, registry=None,
+                 default_model: str = DEFAULT_MODEL):
+        if max_context < 2:
+            raise ValueError(f"max_context={max_context} must be >= 2")
+        pages_per_slot = -(-int(max_context) // int(page_size))
+        if num_pages is None:
+            # default: full occupancy of every slot fits (+ trash page),
+            # so admission only ever sheds on the queue budget
+            num_pages = slots * pages_per_slot + 1
+        self.metrics = GenerationMetrics(registry)
+        self.models = models or ModelRegistry(
+            metrics_registry=self.metrics.registry)
+        self.default_model = default_model
+        self.cache = PagedKVCache(num_pages, page_size, pages_per_slot)
+        self.scheduler = DecodeScheduler(
+            self.cache, slots=slots, max_queue=max_queue,
+            default_deadline_s=deadline_s, metrics=self.metrics)
+        self.scheduler.on_finish = self._on_finish
+        if prefill_buckets is None:
+            prefill_buckets = _pow2_buckets(int(max_context))
+        self.prefill_buckets = tuple(sorted(set(int(b)
+                                                for b in prefill_buckets)))
+        if model is not None:
+            self.models.register(default_model, model)
+        self._programs: "dict[str, GenerationPrograms]" = {}
+        self._pools = None              # decode-thread-owned device state
+        self._swap_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._drain = True
+        self._thread: Optional[threading.Thread] = None
+        self.steady_deliveries = 0      # tokens delivered since start
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "GenerationEngine":
+        """Build + AOT-warm the active version's programs (every prefill
+        bucket and the decode step compile NOW, through the version's
+        RecompileDetector), allocate the live page pools, start the
+        decode thread."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError("engine already started")
+        mv = self.models.active(self.default_model)
+        progs = self._build_programs(mv)
+        self._pools = progs.fresh_pools()
+        self.scheduler.reopen()   # a restart re-arms admission
+        self._stop_event.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="generation-decode")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """With ``drain`` (default) every queued and running request is
+        still served (bounded by ``timeout``); without, queued requests
+        fail 503 now and running ones are evicted at the next step
+        boundary.  Either way no waiter is left hanging."""
+        self._drain = drain
+        self.scheduler.begin_shutdown(drain_pending=drain)
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    "decode thread still draining after %.1fs; failing "
+                    "the remaining requests", timeout)
+                self._drain = False
+                self._thread.join(5.0)
+        self._thread = None
+        self.scheduler.evict_all("shutdown")
+        # anything still queued after the drain window failed because the
+        # ENGINE stopped, not because its own deadline passed: 503
+        self.scheduler.begin_shutdown(drain_pending=False)
+        self._refresh_gauges()
+
+    # ---------------------------------------------------------------- submit
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 32, *,
+               temperature: float = 0.0, top_k: Optional[int] = None,
+               top_p: Optional[float] = None, seed: int = 0,
+               deadline_s: Optional[float] = None,
+               stop_token: Optional[int] = None,
+               trace_id: Optional[str] = None) -> GenerationRequest:
+        """Thread-safe enqueue; returns the request handle (``stream()``
+        for tokens as they decode, ``result()`` to block).  Raises
+        ``QueueFullError`` (429) on a full queue, ``ShuttingDownError``
+        (503) during shutdown, ``ValueError`` for a request that could
+        never fit the page pool."""
+        deadline = self.scheduler.admission.deadline_for(deadline_s)
+        req = GenerationRequest(
+            prompt, max_new_tokens, temperature=temperature, top_k=top_k,
+            top_p=top_p, seed=seed, deadline_s=deadline,
+            stop_token=stop_token, trace_id=trace_id)
+        # worst case (no prefix shared) the WHOLE prompt prefises in one
+        # bucket; reject here with a clean error instead of detonating a
+        # ValueError on the decode thread mid-batch
+        if len(req.prompt) > max(self.prefill_buckets):
+            raise ValueError(
+                f"prompt of {len(req.prompt)} tokens exceeds the largest "
+                f"prefill bucket {max(self.prefill_buckets)}")
+        return self.scheduler.submit(req)
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int = 32,
+                 **kw) -> np.ndarray:
+        """Blocking convenience: submit + wait; returns the generated ids
+        as a 1-D array."""
+        req = self.submit(prompt, max_new_tokens, **kw)
+        return np.asarray(req.result(), np.int32)
+
+    # ----------------------------------------------------------- model admin
+    def deploy(self, name: str, model, *, retain_old: bool = False,
+               drain_timeout: float = 30.0) -> ModelVersion:
+        """Register ``model`` as the next version of ``name`` and hot-swap
+        it in WITHOUT interrupting decode: the incoming version's
+        programs are built and AOT-warmed first (a model that fails its
+        warmup — or whose cache geometry differs from the live pools —
+        aborts here with the old version intact), then the active
+        pointer flips atomically; the decode loop leases per iteration,
+        so the very next step runs the new weights while every in-flight
+        stream keeps its slot, its pages, and its sampling state.  With
+        ``retain_old`` the displaced version stays loaded as the
+        ``rollback`` target."""
+        if name != self.default_model:
+            raise ValueError(
+                f"generation engine serves one model name "
+                f"({self.default_model!r}); decode batches cannot mix "
+                f"models")
+        with self._swap_lock:
+            mv = self.models.new_version(name, model)
+            self._build_programs(mv)   # raises -> swap aborted, old intact
+            self._commit_locked(name, drain_timeout)
+            old = self.models.activate(mv, retain=retain_old)
+            get_flight_recorder().record(
+                "generation_swap", model=name, version=mv.version,
+                replaced=old.version if old else None,
+                retained=bool(retain_old and old is not None))
+            if old is not None:
+                self.metrics.swaps.inc(model=name)
+                if not retain_old:
+                    self._retire(old, drain_timeout)
+            logger.info("generation: %s now serving (replaced %s%s)",
+                        mv.key, old.key if old else "nothing",
+                        ", retained for rollback"
+                        if retain_old and old else "")
+            return mv
+
+    def rollback(self, name: Optional[str] = None, *,
+                 drain_timeout: float = 30.0) -> ModelVersion:
+        """Undo the last retaining swap: flip back to the retained
+        version between decode steps (its programs are still warm — a
+        retained version's program set is only dropped at retire)."""
+        name = name or self.default_model
+        with self._swap_lock:
+            restored, displaced = self.models.rollback(name)
+            get_flight_recorder().record(
+                "generation_rollback", model=name,
+                restored=restored.version,
+                displaced=displaced.version if displaced else None)
+            self.metrics.swaps.inc(model=name)
+            if displaced is not None:
+                self._retire(displaced, drain_timeout)
+            return restored
+
+    def commit_swap(self, name: Optional[str] = None, *,
+                    drain_timeout: float = 30.0) -> Optional[ModelVersion]:
+        """Close the rollback window: retire the retained version."""
+        with self._swap_lock:
+            return self._commit_locked(name or self.default_model,
+                                       drain_timeout)
+
+    def _commit_locked(self, name: str, drain_timeout: float):
+        mv = self.models.release_retained(name)
+        if mv is not None:
+            self._retire(mv, drain_timeout)
+        return mv
+
+    def _retire(self, mv: ModelVersion, timeout: float) -> None:
+        if self.models.retire(mv, timeout=timeout):
+            self._programs.pop(mv.key, None)   # drop its jit caches
+        else:
+            logger.warning("%s still leased after %.1fs; left un-retired",
+                           mv.key, timeout)
+
+    def _build_programs(self, mv: ModelVersion) -> GenerationPrograms:
+        """Programs for one version, AOT-warmed on scratch pools, with
+        the pool geometry validated against the live pools (a deploy
+        whose architecture changes the KV shapes cannot share the
+        in-flight cache and must be rejected)."""
+        progs = GenerationPrograms(
+            mv.model, slots=self.scheduler.num_slots,
+            pages_per_slot=self.cache.pages_per_slot,
+            page_size=self.cache.page_size, num_pages=self.cache.num_pages,
+            prefill_buckets=self.prefill_buckets, detector=mv.detector)
+        if self._pools is not None:
+            live = jax.tree_util.tree_map(
+                lambda a: (a.shape, str(a.dtype)), self._pools)
+            new = jax.tree_util.tree_map(
+                lambda a: (a.shape, str(a.dtype)),
+                jax.eval_shape(progs.fresh_pools))
+            if live != new:
+                raise ValueError(
+                    f"cannot deploy {mv.key}: its paged-cache geometry "
+                    "differs from the live pools (layer names / kv heads "
+                    "/ head dims must match the serving architecture)")
+        progs.warm()
+        self._programs[mv.key] = progs
+        return progs
+
+    # ------------------------------------------------------------ decode loop
+    def _run(self) -> None:
+        while True:
+            stopping = self._stop_event.is_set()
+            if stopping and (not self._drain
+                             or not self.scheduler.has_work):
+                break
+            self.scheduler.purge_pending()
+            try:
+                with self.models.lease(self.default_model) as mv:
+                    progs = self._programs[mv.key]
+                    self._admit(progs, mv)
+                    if self.scheduler.active_slots():
+                        self._step(progs, mv)
+                        continue
+            except Exception as e:
+                logger.exception("decode iteration failed; evicting the "
+                                 "running batch and reseeding the pools")
+                get_flight_recorder().record("generation_error",
+                                             error=str(e)[:200])
+                self.scheduler.evict_all("error", e)
+                try:
+                    self._pools = self._programs[
+                        self.models.active(self.default_model).key
+                    ].fresh_pools()
+                except Exception:
+                    logger.exception("pool reseed failed; decode thread "
+                                     "exiting")
+                    return
+            if not stopping and not self.scheduler.has_work:
+                self.scheduler.wait_for_work(0.05)
+
+    def _admit(self, progs: GenerationPrograms, mv: ModelVersion) -> None:
+        while True:
+            req = self.scheduler.next_admittable()
+            if req is None:
+                return
+            try:
+                self._prefill(progs, mv, req)
+            except Exception as e:
+                # the request holds pages but no slot yet: evict_all in
+                # the outer handler cannot see it, so terminate it here
+                # (pages freed, waiters released, stale prefix-index
+                # entries for its never-written pages removed) and let
+                # the outer handler reset the pools
+                self.scheduler.fail_admitted(req, e)
+                raise
+
+    def _prefill(self, progs: GenerationPrograms, mv: ModelVersion,
+                 req: GenerationRequest) -> None:
+        suffix = req.prompt[req.shared_len:]
+        bucket = progs.bucket_for(len(suffix))
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :len(suffix)] = suffix
+        shared_pages = req.shared_len // self.cache.page_size
+        base_key = _base_key(req.seed)
+        with step_guard("decode_prefill", engine=self.metrics.engine_id,
+                        bucket=bucket, shared_pages=shared_pages):
+            self._pools, tok = progs.prefill(
+                bucket, mv.model.params, mv.model.net_state, self._pools,
+                self.cache.block_row(req.pages)[None],
+                np.asarray([req.shared_len], np.int32),
+                np.int32(len(suffix) - 1), tokens, base_key[None],
+                np.zeros(1, np.int32),
+                np.asarray([req.temperature], np.float32),
+                np.asarray([req.top_k], np.int32),
+                np.asarray([req.top_p], np.float32))
+        first = int(jax.device_get(tok)[0])
+        self.scheduler.install(req, first, base_key)
+        self.metrics.ttft.observe(req.ttft_s)
+        self.metrics.prefix_pages.inc(shared_pages, outcome="shared")
+        self.metrics.prefix_pages.inc(len(req.pages) - shared_pages,
+                                      outcome="fresh")
+        self.metrics.tokens.inc(model=mv.name)
+        self._refresh_gauges()
+
+    def _step(self, progs: GenerationPrograms, mv: ModelVersion) -> None:
+        s = self.scheduler
+        active = len(s.active_slots())
+        with step_guard("decode_step", engine=self.metrics.engine_id,
+                        active=active):
+            self._pools, sampled = progs.decode(
+                mv.model.params, mv.model.net_state, self._pools,
+                s.block, s.pos, s.last_tok, s.keys, s.tok_idx, s.temps,
+                s.top_ks, s.top_ps)
+        delivered = s.after_step(jax.device_get(sampled))
+        self.steady_deliveries += delivered
+        self.metrics.steps.inc()
+        self.metrics.tokens.inc(delivered, model=mv.name)
+        self.metrics.batch_occupancy.observe(active / s.num_slots)
+        self._refresh_gauges()
+
+    def _refresh_gauges(self) -> None:
+        self.metrics.active_slots.set(len(self.scheduler.active_slots()))
+        self.metrics.page_util.set(self.cache.utilization())
+
+    def _on_finish(self, req: GenerationRequest) -> None:
+        """Terminal accounting for every request, whatever path ended it
+        (completion, stop token, cancel, deadline, shutdown, error)."""
+        status = req.finish_reason or "error"
+        self.metrics.requests.inc(status=status)
+        end_ns = time.perf_counter_ns()
+        start_ns = int(req.submitted * 1e9)
+        get_tracer().record_span(
+            "generation_request", start_ns, end_ns,
+            trace_id=req.trace_id, tokens=len(req.tokens), status=status,
+            ttft_ms=(round(req.ttft_s * 1e3, 3)
+                     if req.ttft_s is not None else None))
+
+    # ----------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        return {
+            "model": self.default_model,
+            "models": self.models.as_dict(),
+            "scheduler": self.scheduler.as_dict(),
+            "prefill_buckets": list(self.prefill_buckets),
+            "decode_thread_alive": (self._thread is not None
+                                    and self._thread.is_alive()),
+        }
+
+
+def _base_key(seed: int) -> np.ndarray:
+    """A request's raw uint32 base PRNG key (host copy; folded per token
+    index on device — see ``utils.sampling.sample_tokens``)."""
+    return np.asarray(jax.device_get(jax.random.PRNGKey(seed)), np.uint32)

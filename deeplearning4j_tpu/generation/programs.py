@@ -1,0 +1,232 @@
+"""Compiled generation programs: bucketed prefill + ONE decode step.
+
+The whole engine dispatches exactly ``len(prefill_buckets) + 1`` XLA
+programs per model version, all AOT-warmed before the version serves:
+
+- ``prefill_<bucket>``: one request's (non-shared) prompt suffix, padded
+  up to the bucket length, forwarded through the paged carries in a
+  single [1, bucket] call — writes its K/V into the request's pages and
+  samples the first token from the last REAL prompt position's logits.
+- ``decode``: one token for EVERY slot in a single [slots, 1] call —
+  the iteration-level batch.  Idle slots ride along pointed at the
+  trash page with temperature 0; their lanes are pure garbage-in/
+  garbage-out and the scheduler ignores their outputs.
+
+Shapes are closed by construction (slot count, pool size, block-table
+width, bucket lengths are all fixed at engine construction), so steady
+state compiles exactly nothing — proven through the version's
+``RecompileDetector`` the same way the PR-2 serving warmup proves it.
+
+KV pools are donated on every call: XLA writes the new K/V in place
+instead of copying pool-sized buffers per token.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.models.decode import (
+    _cg_single_io, _ids_need_time_axis, _last_logits_fwd,
+)
+from deeplearning4j_tpu.utils.sampling import _resolve_encoding, sample_tokens
+
+
+def named_layers_of(net) -> List[Tuple[str, object]]:
+    """(name, layer) pairs for either facade — the walk
+    ``models.decode.generate`` uses, shared here for pool seeding."""
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+
+    if isinstance(net, MultiLayerNetwork):
+        return [(l.name, l) for l in net.layers]
+    _cg_single_io(net)   # generation feeds back ONE token stream
+    return [(n, net.nodes[n].layer) for n in net.topo
+            if net.nodes[n].layer is not None]
+
+
+def seed_paged_pools(net, num_pages: int, page_size: int,
+                     dtype=None) -> Dict:
+    """Paged KV pools for every pageable layer of ``net`` (the paged
+    analog of ``models.common.seed_stream_caches``).  Raises when the
+    net carries state that cannot be paged (recurrent hidden state) —
+    the engine must fail at setup, not serve wrong tokens."""
+    cache_dtype = (jnp.dtype(dtype) if dtype else jnp.float32)
+    pools = {}
+    for name, layer in named_layers_of(net):
+        if hasattr(layer, "init_paged_cache"):
+            c = layer.init_paged_cache(num_pages, page_size, cache_dtype)
+            if c is not None:
+                pools[name] = c
+        elif hasattr(layer, "apply_with_carry"):
+            raise ValueError(
+                f"layer '{name}' ({type(layer).__name__}) carries "
+                "non-pageable state; the generation engine only serves "
+                "attention-cached (transformer) stacks")
+    if not pools:
+        raise ValueError(
+            "no pageable attention layers found — the generation engine "
+            "needs at least one causal SelfAttentionLayer KV cache")
+    return pools
+
+
+def _attach(pools, block, pos):
+    """Insert the dispatch's block table / positions into every paged
+    leaf (the pool pytree stays pk/pv-only between dispatches)."""
+    def walk(c):
+        if isinstance(c, dict) and "pk" in c:
+            return {**c, "block": block, "pos": pos}
+        if isinstance(c, dict):
+            return {k: walk(v) for k, v in c.items()}
+        return c
+    return {k: walk(v) for k, v in pools.items()}
+
+
+def _strip(carries):
+    """Keep only the updated pools out of the forward's new carries.
+    The forward returns a carry entry for EVERY carry-capable layer —
+    ``None`` for the ones that ran carry-less (MLP residual blocks) —
+    and those must be dropped, or the output pytree's structure would
+    differ from the input pools' and every warmed program would retrace
+    on its first live call."""
+    def walk(c):
+        if isinstance(c, dict) and "pk" in c:
+            return {"pk": c["pk"], "pv": c["pv"]}
+        if isinstance(c, dict):
+            out = {k: w for k, v in c.items()
+                   if (w := walk(v)) is not None}
+            return out or None
+        return None
+    return {k: w for k, v in (carries or {}).items()
+            if (w := walk(v)) is not None}
+
+
+class GenerationPrograms:
+    """The jitted program set for ONE model version (the engine builds a
+    fresh set per deploy and AOT-warms it before the version serves)."""
+
+    def __init__(self, net, *, slots: int, pages_per_slot: int,
+                 page_size: int, num_pages: int,
+                 prefill_buckets: Tuple[int, ...], detector=None):
+        self.net = net
+        self.slots = int(slots)
+        self.pages_per_slot = int(pages_per_slot)
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.prefill_buckets = tuple(sorted(int(b) for b in prefill_buckets))
+        self.detector = detector
+        probe = np.zeros((1, 1), np.int64)
+        _, self.one_hot, self.vocab_size = _resolve_encoding(
+            net, probe, None, None)
+        self.expand_ids = _ids_need_time_axis(net, self.one_hot)
+        self._fwd = _last_logits_fwd(net)
+        # validate pageability eagerly (raises on recurrent stacks)
+        seed_paged_pools(net, 2, page_size, net.conf.compute_dtype)
+        self._decode = jax.jit(self._make_decode(), donate_argnums=(2,))
+        self._prefill = {
+            b: jax.jit(self._make_prefill(b), donate_argnums=(2,))
+            for b in self.prefill_buckets}
+
+    # ---------------------------------------------------------------- build
+    def fresh_pools(self):
+        return seed_paged_pools(self.net, self.num_pages, self.page_size,
+                                self.net.conf.compute_dtype)
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(
+            f"prompt suffix of {length} tokens exceeds the largest "
+            f"prefill bucket {self.prefill_buckets[-1]}")
+
+    def _encode(self, tokens):
+        if self.one_hot:
+            return jax.nn.one_hot(tokens, self.vocab_size,
+                                  dtype=jnp.float32)
+        return tokens[..., None] if self.expand_ids else tokens
+
+    def _make_decode(self):
+        fwd, encode = self._fwd, self._encode
+
+        def decode_step(params, net_state, pools, block, pos, tokens,
+                        keys, token_idx, temps, top_ks, top_ps):
+            """One token for every slot: [S] in, [S] out."""
+            x = encode(tokens[:, None])
+            pre, nc = fwd(params, net_state, x, _attach(pools, block, pos))
+            logits = pre[:, -1].astype(jnp.float32)
+            nxt = sample_tokens(logits, keys, token_idx, temps, top_ks,
+                                top_ps)
+            return _strip(nc), nxt.astype(jnp.int32)
+
+        return decode_step
+
+    def _make_prefill(self, bucket: int):
+        fwd, encode = self._fwd, self._encode
+
+        def prefill(params, net_state, pools, block, start, last_idx,
+                    tokens, keys, token_idx, temps, top_ks, top_ps):
+            """One request's prompt suffix ([1, bucket]) + first sample.
+            ``start`` [1] is the suffix's global start position (0, or
+            the shared-prefix length); ``last_idx`` () indexes the last
+            REAL token inside the bucket — bucket padding beyond it
+            writes scratch K/V that the causal mask hides until decode
+            overwrites it position by position."""
+            x = encode(tokens)
+            pre, nc = fwd(params, net_state, x,
+                          _attach(pools, block, start))
+            logits = jnp.take(pre[0], last_idx, axis=0)[None]
+            tok = sample_tokens(logits.astype(jnp.float32), keys,
+                                token_idx, temps, top_ks, top_ps)
+            return _strip(nc), tok.astype(jnp.int32)
+
+        return prefill
+
+    # ------------------------------------------------------------- dispatch
+    def decode(self, params, net_state, pools, block, pos, tokens, keys,
+               token_idx, temps, top_ks, top_ps, expected: bool = False):
+        if self.detector is not None:
+            self.detector.check(("decode", tokens, pos, block), {},
+                                expected=expected)
+        return self._decode(params, net_state, pools, block, pos, tokens,
+                            keys, token_idx, temps, top_ks, top_ps)
+
+    def prefill(self, bucket, params, net_state, pools, block, start,
+                last_idx, tokens, keys, token_idx, temps, top_ks, top_ps,
+                expected: bool = False):
+        if self.detector is not None:
+            self.detector.check((f"prefill_{bucket}", tokens, start), {},
+                                expected=expected)
+        return self._prefill[bucket](
+            params, net_state, pools, block, start, last_idx, tokens,
+            keys, token_idx, temps, top_ks, top_ps)
+
+    # --------------------------------------------------------------- warmup
+    def warm(self) -> int:
+        """AOT-compile every program on a SCRATCH pool (donation consumes
+        it; the live pool is never touched) through the version's
+        detector as planned compiles.  Returns the number of programs
+        warmed — after this, steady-state serving compiles nothing."""
+        s, maxp = self.slots, self.pages_per_slot
+        zeros_i = np.zeros
+        pools = self.fresh_pools()
+        for b in self.prefill_buckets:
+            pools, _ = self.prefill(
+                b, self.net.params, self.net.net_state, pools,
+                zeros_i((1, maxp), np.int32), zeros_i((1,), np.int32),
+                np.int32(0), zeros_i((1, b), np.int32),
+                zeros_i((1, 2), np.uint32), zeros_i((1,), np.int32),
+                zeros_i((1,), np.float32), zeros_i((1,), np.int32),
+                np.ones((1,), np.float32), expected=True)
+        pools, tok = self.decode(
+            self.net.params, self.net.net_state, pools,
+            zeros_i((s, maxp), np.int32), zeros_i((s,), np.int32),
+            zeros_i((s,), np.int32), zeros_i((s, 2), np.uint32),
+            zeros_i((s,), np.int32), zeros_i((s,), np.float32),
+            zeros_i((s,), np.int32), np.ones((s,), np.float32),
+            expected=True)
+        jax.block_until_ready(tok)
+        del pools
+        return len(self.prefill_buckets) + 1

@@ -30,23 +30,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from deeplearning4j_tpu.utils.sampling import _filter_logits
-
-
-def _sampler(temperature: float, top_k: Optional[int], top_p: Optional[float]):
-    """Static sampling policy -> pure (logits [B, V], key) -> ids [B]."""
-    if temperature and temperature > 0:
-
-        def sample(logits, key):
-            logits = logits / jnp.asarray(temperature, logits.dtype)
-            return jax.random.categorical(
-                key, _filter_logits(logits, top_k, top_p), axis=-1)
-    else:
-
-        def sample(logits, key):
-            return jnp.argmax(logits, axis=-1)
-
-    return sample
+# the ONE sampling-policy implementation, shared with the host loop and
+# the continuous-batching generation engine (utils.sampling owns it so
+# temperature/top-k/top-p can never diverge across the decode paths)
+from deeplearning4j_tpu.utils.sampling import _sampler  # noqa: F401
 
 
 def _last_logits_fwd(net):

@@ -53,19 +53,26 @@ def check_window(causal: bool, window: Optional[int]) -> None:
 def rope(x: jax.Array, positions: jax.Array,
          theta: float = 10000.0) -> jax.Array:
     """Rotary position embedding on ``[B, T, H, D]`` (RoFormer; public
-    standard).  ``positions`` is the [T] vector of GLOBAL positions, which
-    is what makes the same function serve the full-sequence path, the
-    streaming KV-cache path (q at ``pos + arange``, k rotated at write
-    time), and ring attention (shard offsets).  Odd tail dims (D not a
-    multiple of 2) pass through unrotated."""
+    standard).  ``positions`` is the [T] vector of GLOBAL positions —
+    or, for the paged continuous-batching decode path where every batch
+    row sits at a different stream position, a per-row [B, T] matrix —
+    which is what makes the same function serve the full-sequence path,
+    the streaming KV-cache path (q at ``pos + arange``, k rotated at
+    write time), paged decode (per-slot positions), and ring attention
+    (shard offsets).  Odd tail dims (D not a multiple of 2) pass through
+    unrotated."""
     d = x.shape[-1]
     half = d // 2
     acc = jnp.promote_types(x.dtype, jnp.float32)
     freqs = jnp.power(jnp.asarray(theta, acc),
                       -jnp.arange(0, half, dtype=acc) / max(half, 1))
-    ang = positions.astype(acc)[:, None] * freqs[None, :]      # [T, half]
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(acc)[..., :, None] * freqs  # [(B,) T, half]
+    if positions.ndim == 1:
+        cos = jnp.cos(ang)[None, :, None, :]
+        sin = jnp.sin(ang)[None, :, None, :]
+    else:
+        cos = jnp.cos(ang)[:, :, None, :]
+        sin = jnp.sin(ang)[:, :, None, :]
     x1 = x[..., :half].astype(acc)
     x2 = x[..., half:2 * half].astype(acc)
     out = jnp.concatenate(
@@ -134,6 +141,57 @@ def dot_product_attention(
     if grouped:
         o = jnp.einsum("bhgqk,bkhd->bqhgd", w.astype(v.dtype), v)
         return o.reshape(q.shape[0], q.shape[1], hq, d)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+
+
+def gather_pages(pages: jax.Array, block: jax.Array,
+                 page_size: int) -> jax.Array:
+    """Materialize one batch's logical KV view from a paged pool.
+
+    ``pages`` [P * page_size, Hkv, D] (the flattened pool), ``block``
+    [B, MAXP] int32 per-row page ids: returns [B, MAXP * page_size, Hkv,
+    D] where flat position ``i`` of row ``b`` is global stream position
+    ``i`` of that row's sequence.  This is the paged-gather seam — a
+    fused decode-attention helper (roadmap item 5) replaces exactly this
+    gather + the softmax that follows."""
+    b, maxp = block.shape
+    slots = block[:, :, None] * page_size + jnp.arange(page_size)[None, None]
+    return pages[slots.reshape(b, maxp * page_size)]
+
+
+def paged_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    q_positions: jax.Array) -> jax.Array:
+    """Causal attention of ``q`` [B, T, H, D] over a gathered paged view
+    ``k``/``v`` [B, L, Hkv, D] whose flat index IS the global position
+    (see ``gather_pages``).  ``q_positions`` [B, T] are per-row global
+    query positions — every batch row sits at a different point of its
+    own stream, which is the whole point of continuous batching, so the
+    causal mask is per-row (``dot_product_attention`` masks by a single
+    shared position vector and cannot express this).  Pages past a row's
+    current position hold garbage (unwritten, or bucket-padding scratch);
+    ``kpos > qpos`` masks every one of them.  GQA contracts the
+    UNEXPANDED kv heads, same as the other paths."""
+    d = q.shape[-1]
+    hq, hkv = q.shape[2], k.shape[2]
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    grouped = hq != hkv
+    kpos = jnp.arange(k.shape[1])
+    cm = q_positions[:, :, None] >= kpos[None, None, :]   # [B, T, L]
+    neg = jnp.asarray(-1e30, acc)
+    if grouped:
+        qg = q.reshape(q.shape[0], q.shape[1], hkv, hq // hkv, d)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k).astype(acc)
+        scores = scores / jnp.sqrt(jnp.asarray(d, acc))
+        scores = jnp.where(cm[:, None, None], scores, neg)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhgqk,bkhd->bqhgd", w.astype(v.dtype), v)
+        return o.reshape(q.shape[0], q.shape[1], hq, d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(acc)
+    scores = scores / jnp.sqrt(jnp.asarray(d, acc))
+    scores = jnp.where(cm[:, None], scores, neg)
+    w = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
 
 
@@ -247,6 +305,65 @@ class SelfAttentionLayer(Layer):
                                      jnp.int32)
         return cache
 
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         dtype=jnp.float32) -> Dict[str, jax.Array]:
+        """KV pool for PAGED streaming inference (the continuous-batching
+        generation engine, ``deeplearning4j_tpu/generation/``): instead of
+        one contiguous [B, max_cache] cache per stream, K/V live in a
+        shared pool of ``num_pages`` fixed-size pages; each running
+        request addresses its pages through an int32 block table the
+        engine passes per dispatch (``carry["block"]``/``carry["pos"]``
+        alongside these pools).  Pool shapes are the ONLY shapes XLA ever
+        sees, so slot count and pool size close the decode shape set.
+        Like the linear cache, GQA pools store the UNEXPANDED kv heads."""
+        if self.window is not None:
+            raise ValueError(
+                "paged KV caching does not support sliding-window "
+                f"attention (window={self.window}): pages are addressed "
+                "by absolute position; use the rolling cache for "
+                "windowed streaming")
+        if not self.causal or self.seq_axis is not None:
+            raise ValueError(
+                "paged KV caching requires causal=True attention without "
+                f"seq_axis (got causal={self.causal}, "
+                f"seq_axis={self.seq_axis})")
+        d_head = self.n_out // self.n_heads
+        shape = (num_pages, page_size, self._kv_heads, d_head)
+        return {"pk": jnp.zeros(shape, dtype), "pv": jnp.zeros(shape, dtype)}
+
+    def _apply_paged(self, params, state, q, k, v, carry):
+        """The paged-gather decode path (sibling of the rolling/linear
+        branches below): write this chunk's K/V into the pool at the
+        rows' global positions through the block table, gather each
+        row's logical view back, attend causally by per-row position.
+        Write-before-gather is correct here (pages never overwrite
+        in-band keys, unlike the rolling ring) and makes the chunk's own
+        keys visible to its own later queries."""
+        block, pos = carry["block"], carry["pos"]      # [B, MAXP], [B]
+        ps = carry["pk"].shape[1]
+        t_new = q.shape[1]
+        new_pos = pos[:, None] + jnp.arange(t_new, dtype=pos.dtype)
+        if self.rope:
+            # rotate by each ROW's global positions (rows sit at
+            # different points of their own streams)
+            q = rope(q, new_pos, self.rope_theta)
+            k = rope(k, new_pos, self.rope_theta)
+        page = jnp.take_along_axis(block, new_pos // ps, axis=1)
+        flat = (page * ps + new_pos % ps).reshape(-1)
+        hkv, dh = k.shape[2], k.shape[3]
+        pkf = carry["pk"].reshape(-1, hkv, dh)
+        pvf = carry["pv"].reshape(-1, hkv, dh)
+        pkf = pkf.at[flat].set(k.reshape(-1, hkv, dh).astype(pkf.dtype))
+        pvf = pvf.at[flat].set(v.reshape(-1, hkv, dh).astype(pvf.dtype))
+        gk = gather_pages(pkf, block, ps).astype(q.dtype)
+        gv = gather_pages(pvf, block, ps).astype(q.dtype)
+        o = paged_attention(q, gk, gv, new_pos)
+        new_carry = {"pk": pkf.reshape(carry["pk"].shape),
+                     "pv": pvf.reshape(carry["pv"].shape),
+                     "block": block, "pos": pos + t_new}
+        y = merge_heads(o) @ params["Wo"] + params["bo"]
+        return activations.get(self.activation)(y), state, new_carry
+
     @staticmethod
     def cache_overflow(carry, t_new: int, pos: Optional[int] = None) -> bool:
         """Would appending ``t_new`` steps exceed the cache?  Checked
@@ -285,6 +402,10 @@ class SelfAttentionLayer(Layer):
         q = split_heads(x @ params["Wq"] + params["bq"], self.n_heads)
         k = split_heads(x @ params["Wk"] + params["bk"], self._kv_heads)
         v = split_heads(x @ params["Wv"] + params["bv"], self._kv_heads)
+        if "pk" in carry:
+            # paged mode (continuous batching): per-ROW positions and a
+            # block-table-addressed pool; see _apply_paged
+            return self._apply_paged(params, state, q, k, v, carry)
         t_new = q.shape[1]
         pos = carry["pos"]
         new_pos = pos + jnp.arange(t_new, dtype=pos.dtype)

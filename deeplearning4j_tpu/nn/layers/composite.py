@@ -100,6 +100,30 @@ class ResidualBlock(Layer):
                 carryable = True
         return carry if carryable else None
 
+    def init_paged_cache(self, num_pages: int, page_size: int,
+                         dtype=jnp.float32):
+        """Paged-pool carries for pageable sublayers (attention KV pools —
+        see ``SelfAttentionLayer.init_paged_cache``).  A sublayer that is
+        carryable but NOT pageable (recurrent state) makes the whole block
+        unpageable: the continuous-batching engine needs every carry to be
+        slot-addressable through the block table, and recurrent hidden
+        state is not — it raises so the engine fails loudly at setup."""
+        carry = {}
+        pageable = False
+        for i, sub in enumerate(self.layers):
+            if hasattr(sub, "init_paged_cache"):
+                pageable = True
+                c = sub.init_paged_cache(num_pages, page_size, dtype)
+                if c is not None:
+                    carry[f"sub{i}"] = c
+            elif hasattr(sub, "apply_with_carry"):
+                raise ValueError(
+                    f"ResidualBlock sublayer {type(sub).__name__} carries "
+                    "state but has no paged-cache form; the generation "
+                    "engine only serves fully pageable (attention-cached) "
+                    "stacks")
+        return carry if pageable else None
+
     def apply_with_carry(self, params, state, x, carry, *, train=False,
                          rng=None, mask=None):
         """carry=None -> exact ``apply`` (training/batch paths untouched).

@@ -80,6 +80,19 @@ DEFAULT_RULES: List[Rule] = [
     Rule("Decode tokens/sec", tolerance=0.4),
     Rule("Decode tokens/sec", field="variants.gqa2_rolling.tokens_per_sec",
          tolerance=0.4, required=False),
+    # continuous-batching generation (bench_generation): the aggregate
+    # 16-client decode throughput is the headline the paged-KV engine
+    # exists for; the speedup-vs-single-stream ratio guards the batching
+    # win itself (an aggregate that only tracks single-stream drift
+    # would let the scheduler silently serialize); the exact zero rule
+    # pins the decode-side AOT-warmup contract.
+    Rule("Generation tokens/sec", tolerance=0.4),
+    Rule("Generation tokens/sec", field="speedup_vs_single_stream",
+         tolerance=0.4, required=False),
+    Rule("Generation tokens/sec", field="p99_ttft_ms", direction=LOWER,
+         tolerance=1.0, required=False),
+    Rule("Generation tokens/sec", field="steady_state_compiles",
+         direction=LOWER, tolerance=0.0, required=False),
     Rule("Long-context train tokens/sec", tolerance=0.4),
     Rule("Serving rows/sec", tolerance=0.4),
     Rule("Serving rows/sec", field="p99_ms", direction=LOWER, tolerance=1.0,

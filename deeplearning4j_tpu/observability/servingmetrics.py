@@ -87,3 +87,69 @@ class ServingMetrics:
         """Replace the live callback with 0 at engine stop (other engines'
         children are untouched)."""
         self.queue_depth.set(0.0)
+
+
+_TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                 1.0, 2.5, 5.0, 10.0)
+
+
+class GenerationMetrics:
+    """Decode/continuous-batching families (``dl4j_decode_*``) — the one
+    owner of their names/labels, same contract as ``ServingMetrics``.
+    Per-instance gauges are labeled ``engine=`` with a process-unique id
+    so a second generation engine neither clobbers nor zeroes the
+    first's."""
+
+    def __init__(self, registry=None, engine_id: str = None):
+        reg = registry if registry is not None else get_registry()
+        self.registry = reg
+        self.engine_id = (engine_id if engine_id is not None
+                          else f"g{next(_ENGINE_IDS)}")
+        self.requests = reg.counter(
+            "dl4j_decode_requests_total",
+            "Generation requests by terminal outcome (length/stop = "
+            "completed; cancelled/deadline/shutdown/error = not)",
+            labels=("status",))
+        self.tokens = reg.counter(
+            "dl4j_decode_tokens_total",
+            "Tokens generated and delivered to request streams",
+            labels=("model",))
+        self.steps = reg.counter(
+            "dl4j_decode_steps_total",
+            "Decode-step dispatches (one per running-batch iteration)")
+        self.prefix_pages = reg.counter(
+            "dl4j_decode_prefix_pages_total",
+            "Prompt pages at admission, by whether an identical in-flight "
+            "prefix let them be shared (refcounted) instead of prefilled "
+            "fresh — shared/(shared+fresh) is the prefix-share hit rate",
+            labels=("outcome",))
+        self.ttft = reg.histogram(
+            "dl4j_decode_ttft_seconds",
+            "Time to first token: submit -> first sampled token delivered "
+            "(queue wait + prefill)", buckets=_TTFT_BUCKETS)
+        self.shed = reg.counter(
+            "dl4j_decode_shed_total",
+            "Generation requests shed by admission control, by reason",
+            labels=("reason",))
+        self.evictions = reg.counter(
+            "dl4j_decode_evicted_total",
+            "Requests removed from the RUNNING batch mid-flight (pages "
+            "freed before completion), by reason",
+            labels=("reason",))
+        self.swaps = reg.counter(
+            "dl4j_decode_model_swaps_total",
+            "Completed generation-model hot-swaps", labels=("model",))
+        # per-instance children
+        self.active_slots = reg.gauge(
+            "dl4j_decode_active_slots",
+            "Requests currently holding a decode slot",
+            labels=("engine",)).labels(engine=self.engine_id)
+        self.page_util = reg.gauge(
+            "dl4j_decode_page_utilization",
+            "Allocated fraction of the paged KV pool (trash page "
+            "excluded)", labels=("engine",)).labels(engine=self.engine_id)
+        self.batch_occupancy = reg.histogram(
+            "dl4j_decode_batch_occupancy",
+            "Active slots per dispatched decode step / total slots (1.0 = "
+            "every lane did useful work)",
+            buckets=_UTIL_BUCKETS)

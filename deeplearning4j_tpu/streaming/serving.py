@@ -59,6 +59,19 @@ class InferenceServer:
     - ``POST /models/<name>`` — hot-swap: body ``{"path": <checkpoint>}``
       loads a ``models/serialization.py`` zip, warms every bucket shape,
       and atomically swaps it in with zero dropped requests.
+    - ``POST /generate`` (when a ``generation=`` engine is wired) —
+      continuous-batching autoregressive decode: body ``{"prompt":
+      [ids], "max_tokens": n, "temperature": t, "top_k": k, "top_p": p,
+      "seed": s, "stop_token": id, "stream": bool}``.  Without
+      ``stream`` the full completion returns as ``{"tokens": [...],
+      "finish_reason": ..., "ttft_ms": ..., "trace_id": ...}``; with
+      ``stream: true`` the response is Server-Sent Events — one ``data:
+      {"token": id, "index": i}`` event per generated token as the
+      running decode batch produces it, closed by ``data: {"done":
+      true, ...}`` (an error mid-stream becomes a final ``data:
+      {"error": ...}`` event: the status line already went out).  Shed/
+      deadline mapping (429/503/504), ``X-Request-Id`` trace ids, and
+      the access log behave exactly as on ``/predict``.
 
     Request tracing: every ``/predict`` request gets a ``trace_id`` —
     taken from an ``X-Request-Id`` header when the client sent one,
@@ -79,7 +92,8 @@ class InferenceServer:
                  max_queue: int = 256, deadline_s: float = 30.0,
                  example: Optional[np.ndarray] = None,
                  engine: Optional[ServingEngine] = None,
-                 health_rules=None, access_log: bool = False):
+                 health_rules=None, access_log: bool = False,
+                 generation=None):
         if engine is None:
             if model is None:
                 raise ValueError("InferenceServer needs a model or an engine")
@@ -98,6 +112,10 @@ class InferenceServer:
                     "register extra models via engine.deploy()")
             self._owns_engine = False
         self.engine = engine
+        # optional generation.GenerationEngine behind POST /generate; its
+        # lifecycle (start/stop, deploys) belongs to its owner — the
+        # server only routes, exactly like a shared predict engine
+        self.generation = generation
         self.model = model
         self.max_batch = engine.policy.max_batch
         self.max_wait_ms = engine.batcher.max_wait_s * 1000.0
@@ -149,6 +167,28 @@ class InferenceServer:
                 "total_ms": br["total_ms"],
             }))
         except Exception:   # an access-log failure must never 500 a reply
+            logger.debug("access-log line failed", exc_info=True)
+
+    def _gen_access_line(self, trace_id: str, status: str, http_status: int,
+                         req=None) -> None:
+        """The /generate analog of ``_access_line``: same logger, same
+        trace-id key, generation-shaped fields (token count, TTFT)."""
+        if not self.access_log:
+            return
+        try:
+            access_logger.info(json.dumps({
+                "trace_id": trace_id,
+                "endpoint": "generate",
+                "status": status,
+                "http_status": http_status,
+                "tokens": len(req.tokens) if req is not None else None,
+                "ttft_ms": (round(req.ttft_s * 1e3, 3)
+                            if req is not None and req.ttft_s is not None
+                            else None),
+                "finish_reason": (req.finish_reason
+                                  if req is not None else None),
+            }))
+        except Exception:
             logger.debug("access-log line failed", exc_info=True)
 
     # ------------------------------------------------------------- lifecycle
@@ -214,6 +254,8 @@ class InferenceServer:
                 try:
                     if self.path == "/predict":
                         self._predict()
+                    elif self.path == "/generate":
+                        self._generate()
                     elif (self.path.startswith("/models/")
                           and self.path.endswith("/rollback")):
                         self._rollback(
@@ -240,7 +282,10 @@ class InferenceServer:
                     # log BEFORE the response flushes: the client must
                     # never observe a completed request whose access-log
                     # line has not been emitted yet
-                    server._access_line(tid, etype, code, None)
+                    if self.path == "/generate":
+                        server._gen_access_line(tid, etype, code, None)
+                    else:
+                        server._access_line(tid, etype, code, None)
                 self._json(body, code=code)
 
             def _predict(self):
@@ -275,6 +320,98 @@ class InferenceServer:
                 # log BEFORE the response flushes (see _error_json)
                 server._access_line(tid, "ok", 200, None)
                 self._json({**array_to_base64(out), "trace_id": tid})
+
+            def _generate(self):
+                """POST /generate — continuous-batching decode.  The
+                request joins the RUNNING decode batch at the next step
+                boundary; shed/deadline semantics mirror /predict."""
+                gen = server.generation
+                if gen is None:
+                    raise _BadRequest(
+                        "this server has no generation engine (pass "
+                        "generation= to InferenceServer)")
+                tid = self.headers.get("X-Request-Id") or new_trace_id()
+                self._trace_id = tid
+                obj = self._read_json()
+                if not isinstance(obj, dict) or "prompt" not in obj:
+                    raise _BadRequest(
+                        'generate body must be {"prompt": [token ids], ...}')
+                stream = bool(obj.get("stream", False))
+                try:
+                    prompt = [int(t) for t in obj["prompt"]]
+                    req = gen.submit(
+                        prompt,
+                        max_new_tokens=int(obj.get("max_tokens", 32)),
+                        temperature=float(obj.get("temperature", 0.0)),
+                        top_k=obj.get("top_k"),
+                        top_p=obj.get("top_p"),
+                        seed=int(obj.get("seed", 0)),
+                        deadline_s=obj.get("deadline_s"),
+                        stop_token=obj.get("stop_token"),
+                        trace_id=tid)
+                except ServingError:
+                    raise          # 429/503 mapping via do_POST
+                except (TypeError, ValueError) as e:
+                    raise _BadRequest(f"bad generate request: {e}")
+                if stream:
+                    self._stream_tokens(gen, req, tid)
+                    return
+                try:
+                    tokens = req.result()
+                except ServingError:
+                    raise          # 504 deadline / 503 shutdown mapping
+                except Exception as e:   # model/decode failure -> 400
+                    server._gen_access_line(tid, type(e).__name__, 400, req)
+                    self._json({"error": str(e), "trace_id": tid}, code=400)
+                    return
+                server._gen_access_line(tid, "ok", 200, req)
+                self._json({"tokens": tokens,
+                            "finish_reason": req.finish_reason,
+                            "ttft_ms": (round(req.ttft_s * 1e3, 3)
+                                        if req.ttft_s is not None else None),
+                            "trace_id": tid})
+
+            def _stream_tokens(self, gen, req, tid):
+                """Server-Sent Events: one event per token as the decode
+                batch produces it.  The 200 goes out before the first
+                token, so a later failure is delivered as a terminal
+                ``data: {"error": ...}`` event instead of a status."""
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-store")
+                self.send_header("Connection", "close")
+                self.end_headers()
+
+                def event(payload):
+                    self.wfile.write(
+                        f"data: {json.dumps(payload)}\n\n".encode())
+                    self.wfile.flush()
+
+                status, code = "ok", 200
+                try:
+                    for i, tok in enumerate(req.stream()):
+                        event({"token": tok, "index": i, "trace_id": tid})
+                    event({"done": True, "tokens": len(req.tokens),
+                           "finish_reason": req.finish_reason,
+                           "ttft_ms": (round(req.ttft_s * 1e3, 3)
+                                       if req.ttft_s is not None else None),
+                           "trace_id": tid})
+                except ServingError as e:
+                    status, code = type(e).__name__, e.http_status
+                    event({"error": str(e), "type": status,
+                           "trace_id": tid, "done": True})
+                except BrokenPipeError:
+                    # client went away: stop wasting decode slots on it
+                    req.cancel()
+                    status, code = "client_disconnected", 499
+                except Exception as e:
+                    status, code = type(e).__name__, 500
+                    try:
+                        event({"error": str(e), "type": status,
+                               "trace_id": tid, "done": True})
+                    except Exception:
+                        pass
+                server._gen_access_line(tid, status, code, req)
 
             def _swap(self, name):
                 obj = self._read_json()

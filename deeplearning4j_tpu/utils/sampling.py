@@ -6,6 +6,15 @@ in the DL4J GravesLSTM example family: prime the RNN with a prompt via
 feed the sample back).  Works unchanged for both model families because
 both stream through ``rnn_time_step``: LSTMs carry hidden state,
 transformers carry KV caches.
+
+This module is also the ONE owner of the sampling policy (temperature /
+top-k / top-p logit filtering + the categorical draw) for every decode
+path in the repo: the host loop here, the compiled ``lax.scan`` decode in
+``models/decode.py`` (static per-program policy via ``_sampler``), and the
+continuous-batching generation engine (per-slot RUNTIME policy arrays via
+``sample_tokens`` — one compiled decode step serves requests with mixed
+sampling configs).  All three route through ``_filter_logits`` so the
+kept-set semantics can never diverge between paths.
 """
 
 from __future__ import annotations
@@ -17,33 +26,96 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _filter_logits(logits: jax.Array, top_k: Optional[int],
-                   top_p: Optional[float]) -> jax.Array:
+def _filter_logits(logits: jax.Array, top_k=None, top_p=None) -> jax.Array:
     """Standard nucleus/top-k logit filtering: everything outside the kept
-    set drops to -inf before the categorical draw."""
+    set drops to -inf before the categorical draw.
+
+    ``top_k`` / ``top_p`` are either static Python numbers (validated
+    eagerly — the host loop and the compiled-scan decode bake the policy
+    into the program) or traced ``[B]`` arrays (the generation engine's
+    per-slot policy, one value per running request).  Array semantics:
+    ``top_k < 1`` and ``top_p >= 1`` mean "disabled" for that row — the
+    runtime analog of passing None, so one compiled program covers every
+    per-request mix."""
     neg = jnp.asarray(-1e30, logits.dtype)
+    v = logits.shape[-1]
     if top_k is not None:
-        if top_k < 1:
-            raise ValueError(f"top_k={top_k} must be >= 1")
-        k = min(top_k, logits.shape[-1])   # clamp to vocab
-        kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+        if isinstance(top_k, (int, np.integer)):
+            if top_k < 1:
+                raise ValueError(f"top_k={top_k} must be >= 1")
+            k = min(int(top_k), v)   # clamp to vocab
+            kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+        else:
+            # per-row runtime k: <1 disables (clamps to the full vocab)
+            karr = jnp.asarray(top_k, jnp.int32)
+            k = jnp.where(karr >= 1, jnp.minimum(karr, v), v)
+            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+            kth = jnp.take_along_axis(sorted_desc, (k - 1)[..., None],
+                                      axis=-1)
         logits = jnp.where(logits >= kth, logits, neg)
     if top_p is not None:
-        if not 0.0 < top_p <= 1.0:
-            raise ValueError(f"top_p={top_p} must be in (0, 1]; for greedy "
-                             "use temperature=0")
+        if isinstance(top_p, (float, int, np.floating, np.integer)):
+            if not 0.0 < top_p <= 1.0:
+                raise ValueError(f"top_p={top_p} must be in (0, 1]; for "
+                                 "greedy use temperature=0")
+            p = jnp.asarray(top_p, logits.dtype)
+        else:
+            # per-row runtime p: values >= 1 keep everything (disabled)
+            p = jnp.clip(jnp.asarray(top_p, logits.dtype),
+                         jnp.finfo(logits.dtype).tiny, 1.0)[..., None]
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # keep the smallest prefix with cumulative mass >= top_p (always
         # keep the argmax)
-        keep_sorted = cum - probs < top_p
+        keep_sorted = cum - probs < p
         # threshold = the SMALLEST kept logit
         cutoff = jnp.min(jnp.where(keep_sorted, sorted_logits,
                                    jnp.asarray(jnp.inf, logits.dtype)),
                          axis=-1, keepdims=True)
         logits = jnp.where(logits >= cutoff, logits, neg)
     return logits
+
+
+def _sampler(temperature: float, top_k: Optional[int],
+             top_p: Optional[float]):
+    """Static sampling policy -> pure ``(logits [B, V], key) -> ids [B]``.
+    ``temperature <= 0`` means greedy argmax (top-k/top-p ignored — the
+    kept set never changes the argmax)."""
+    if temperature and temperature > 0:
+
+        def sample(logits, key):
+            logits = logits / jnp.asarray(temperature, logits.dtype)
+            return jax.random.categorical(
+                key, _filter_logits(logits, top_k, top_p), axis=-1)
+    else:
+
+        def sample(logits, key):
+            return jnp.argmax(logits, axis=-1)
+
+    return sample
+
+
+def sample_tokens(logits: jax.Array, keys: jax.Array, token_idx: jax.Array,
+                  temperature: jax.Array, top_k: jax.Array,
+                  top_p: jax.Array) -> jax.Array:
+    """Per-row runtime sampling for a mixed decode batch.
+
+    ``logits`` [B, V]; ``keys`` [B, 2] uint32 per-REQUEST base keys;
+    ``token_idx`` [B] int32 index of the token being drawn (the draw key
+    is ``fold_in(base_key, token_idx)``, so a request's stream depends
+    only on its seed and position — never on which slot it occupies or
+    who else is in the batch); ``temperature`` [B] (<= 0 -> greedy);
+    ``top_k`` [B] int32 (< 1 disables); ``top_p`` [B] (>= 1 disables).
+    Same policy math as ``_sampler`` row-for-row (shared
+    ``_filter_logits``)."""
+    step_keys = jax.vmap(jax.random.fold_in)(keys, token_idx)
+    temp = jnp.asarray(temperature, logits.dtype)
+    safe_t = jnp.where(temp > 0, temp, jnp.ones_like(temp))
+    filtered = _filter_logits(logits / safe_t[:, None], top_k, top_p)
+    drawn = jax.vmap(lambda k, l: jax.random.categorical(k, l, axis=-1))(
+        step_keys, filtered)
+    return jnp.where(temp > 0, drawn, jnp.argmax(logits, axis=-1))
 
 
 def _resolve_encoding(net, prompt_ids, one_hot: Optional[bool],
@@ -133,16 +205,16 @@ def sample_sequence(net, prompt_ids, steps: int, *,
     probs = net.rnn_time_step(encode(prompt_ids))
     probs = probs[:, -1] if probs.ndim == 3 else probs
 
+    # the one shared policy implementation (also used by the compiled-scan
+    # and continuous-batching decode paths); this loop feeds it log-probs,
+    # which only differ from the head's logits by a per-row constant the
+    # softmax/argmax inside are invariant to
+    sample = _sampler(temperature, top_k, top_p)
     out = []
     tok = None
     for _ in range(steps):
-        if temperature and temperature > 0:
-            rng, key = jax.random.split(rng)
-            logits = jnp.log(jnp.maximum(probs, 1e-30)) / temperature
-            logits = _filter_logits(logits, top_k, top_p)
-            tok = jax.random.categorical(key, logits, axis=-1)
-        else:
-            tok = jnp.argmax(probs, axis=-1)
+        rng, key = jax.random.split(rng)
+        tok = sample(jnp.log(jnp.maximum(probs, 1e-30)), key)
         out.append(np.asarray(tok))
         probs = net.rnn_time_step(encode(np.asarray(tok)[:, None]))
         probs = probs[:, -1] if probs.ndim == 3 else probs

@@ -82,6 +82,12 @@ def pytest_configure(config):
         "per-layer gradient/update/activation stats, anomaly rules, "
         "SSE/run-comparison UI endpoints, crash-safe stats storage "
         "(python -m pytest -m introspect)")
+    config.addinivalue_line(
+        "markers",
+        "generation: continuous-batching generation-engine tests — "
+        "paged KV cache with prefix sharing, iteration-level join/leave "
+        "scheduling, zero-recompile decode, hot-swap under decode load, "
+        "streaming HTTP surface (python -m pytest -m generation)")
 
 
 def pytest_collection_modifyitems(config, items):

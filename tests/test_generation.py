@@ -1,0 +1,570 @@
+"""Continuous-batching generation engine (`deeplearning4j_tpu/generation/`).
+
+Acceptance oracles from the PR issue:
+
+- mixed join/leave decode traffic produces BIT-IDENTICAL tokens to
+  isolated sequential decode of each request (scheduler/paging oracle);
+- prefix-sharing refcount/free correctness;
+- page exhaustion sheds with 429 instead of hanging;
+- model hot-swap under continuous decode load: zero dropped/corrupted
+  streams;
+- deterministic seeded sampling regardless of slot placement / batch
+  composition;
+- zero steady-state compiles under mixed traffic (per-program jit cache
+  sizes AND the version's RecompileDetector);
+- one shared sampling-policy implementation across the three decode
+  paths (host loop / compiled scan / engine), parity-tested.
+"""
+
+import json
+import threading
+import time
+
+import http.client
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.generation import GenerationEngine, PagedKVCache
+from deeplearning4j_tpu.models.zoo import transformer_char_lm
+from deeplearning4j_tpu.serving.admission import (
+    DeadlineExceededError, QueueFullError,
+)
+
+pytestmark = pytest.mark.generation
+
+VOCAB = 29
+
+
+def small_lm(seed=12345, d_model=32, layers=2, **kw):
+    return transformer_char_lm(vocab_size=VOCAB, d_model=d_model,
+                               n_heads=4, layers=layers, max_cache=128,
+                               seed=seed, **kw)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    return small_lm()
+
+
+@pytest.fixture(scope="module")
+def engine(lm):
+    eng = GenerationEngine(lm, slots=4, page_size=4, max_context=32,
+                           max_queue=64, deadline_s=30.0)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def _prompts(rng, n, lo=1, hi=12):
+    return [rng.randint(0, VOCAB, rng.randint(lo, hi)).tolist()
+            for _ in range(n)]
+
+
+# --------------------------------------------------------------- the oracle
+def test_join_leave_parity_vs_sequential(engine, rng):
+    """Mixed concurrent traffic (requests joining and leaving the
+    RUNNING batch at different steps) must produce bit-identical greedy
+    tokens to the same requests decoded one at a time."""
+    prompts = _prompts(rng, 10)
+    lens = [int(rng.randint(2, 10)) for _ in prompts]
+
+    # isolated sequential reference (one request in flight at a time)
+    seq = [engine.generate(p, n).tolist() for p, n in zip(prompts, lens)]
+
+    # concurrent, staggered: different max tokens => leaves mid-batch,
+    # staggered submits => joins mid-batch
+    handles = []
+    for i, (p, n) in enumerate(zip(prompts, lens)):
+        handles.append(engine.submit(p, n))
+        if i % 3 == 0:
+            time.sleep(0.002)
+    mixed = [h.result(timeout=60) for h in handles]
+    assert mixed == seq
+    assert all(h.finish_reason == "length" for h in handles)
+
+
+def test_matches_compiled_scan_decode(engine, lm, rng):
+    """The paged engine's greedy continuation equals the single-stream
+    compiled ``lax.scan`` decode (``models/decode.generate``) — the
+    model-correctness cross-check between independent decode paths."""
+    from deeplearning4j_tpu.models.decode import generate
+
+    prompt = rng.randint(0, VOCAB, (1, 7))
+    ref = generate(lm, prompt, 10, temperature=0.0)[0].tolist()
+    got = engine.generate(prompt[0], 10).tolist()
+    assert got == ref
+
+
+def test_seeded_sampling_slot_invariant(engine, rng):
+    """A seeded sampled request must produce identical tokens whatever
+    slot it lands in and whoever shares the batch (keys fold per
+    request seed + token index, never per slot)."""
+    prompt = rng.randint(0, VOCAB, 6).tolist()
+    kw = dict(temperature=0.9, top_k=7, top_p=0.95, seed=123)
+    alone = engine.generate(prompt, 8, **kw).tolist()
+
+    # same request next to unrelated noise traffic
+    noise = [engine.submit(p, 6, temperature=1.1, seed=50 + i)
+             for i, p in enumerate(_prompts(rng, 3))]
+    busy = engine.generate(prompt, 8, **kw).tolist()
+    for h in noise:
+        h.result(timeout=60)
+    assert busy == alone
+
+
+def test_sampler_shared_across_paths(rng):
+    """One policy implementation: the static ``_sampler`` (host loop +
+    compiled scan) and the runtime-array ``sample_tokens`` (engine)
+    agree draw-for-draw, and ``models.decode`` imports the shared
+    symbol rather than owning a copy."""
+    from deeplearning4j_tpu.models import decode
+    from deeplearning4j_tpu.utils import sampling
+    from deeplearning4j_tpu.utils.sampling import _sampler, sample_tokens
+
+    assert decode._sampler is sampling._sampler
+    logits = jnp.asarray(rng.randn(1, 40).astype(np.float32) * 2)
+    base = jax.random.PRNGKey(9)
+    raw = np.asarray(jax.device_get(base), np.uint32)[None]
+    for t, k, p in [(1.0, None, None), (0.8, 5, None), (1.2, None, 0.9),
+                    (0.7, 6, 0.85), (0.0, 3, 0.5)]:
+        stat = _sampler(t, k, p)
+        for idx in range(3):
+            a = int(np.asarray(stat(logits, jax.random.fold_in(base, idx)))[0])
+            b = int(np.asarray(sample_tokens(
+                logits, raw, jnp.asarray([idx], jnp.int32),
+                jnp.asarray([t], jnp.float32),
+                jnp.asarray([k or 0], jnp.int32),
+                jnp.asarray([p or 1.0], jnp.float32)))[0])
+            assert a == b, (t, k, p, idx)
+
+
+def test_filter_logits_static_vs_runtime(rng):
+    from deeplearning4j_tpu.utils.sampling import _filter_logits
+
+    logits = jnp.asarray(rng.randn(3, 17).astype(np.float32))
+    for k, p in [(4, None), (None, 0.7), (5, 0.8)]:
+        stat = _filter_logits(logits, k, p)
+        run = _filter_logits(
+            logits,
+            None if k is None else jnp.full((3,), k, jnp.int32),
+            None if p is None else jnp.full((3,), p, jnp.float32))
+        np.testing.assert_array_equal(np.asarray(stat), np.asarray(run))
+    # runtime disabled sentinels == no filtering
+    off = _filter_logits(logits, jnp.zeros((3,), jnp.int32),
+                         jnp.ones((3,), jnp.float32))
+    np.testing.assert_array_equal(np.asarray(off), np.asarray(logits))
+
+
+# ---------------------------------------------------------- prefix sharing
+def test_prefix_cache_refcounts_unit():
+    cache = PagedKVCache(num_pages=12, page_size=4, pages_per_slot=4)
+    prompt = list(range(9))                       # 2 full pages + 1
+    pages_a, shared_a = cache.admit(prompt, 4)    # occupancy 12 -> 3 pages
+    assert shared_a == 0 and len(pages_a) == 3
+    pages_b, shared_b = cache.admit(prompt, 4)    # identical prefix
+    assert shared_b == 8                          # both full pages shared
+    assert pages_b[:2] == pages_a[:2]
+    assert cache.refcount(pages_a[0]) == 2
+    # a diverging prompt shares only the first page
+    pages_c, shared_c = cache.admit(prompt[:4] + [27, 27, 27, 27, 1], 4)
+    assert shared_c == 4 and pages_c[0] == pages_a[0]
+    assert cache.refcount(pages_a[0]) == 3
+    cache.free(pages_b)
+    cache.free(pages_c)
+    assert cache.refcount(pages_a[0]) == 1
+    cache.free(pages_a)
+    assert cache.free_pages == 11                 # everything returned
+    assert cache.as_dict()["prefix_index_size"] == 0
+    with pytest.raises(AssertionError):
+        cache.free(pages_a[:1])                   # double free is a bug
+
+
+def test_prefix_share_cap_leaves_one_token():
+    """A prompt whose every page is cached must still prefill >= 1 token
+    (the last position's logits seed the first sample and are not part
+    of the shared pages)."""
+    cache = PagedKVCache(num_pages=12, page_size=4, pages_per_slot=4)
+    prompt = list(range(8))                       # exactly 2 pages
+    a, _ = cache.admit(prompt, 4)
+    b, shared = cache.admit(prompt, 4)
+    assert shared == 4                            # NOT 8: one page re-run
+    cache.free(a)
+    cache.free(b)
+
+
+def test_prefix_sharing_under_load(engine, rng):
+    """Two in-flight requests with the same long prompt share pages
+    (visible in allocator counters) and still produce identical greedy
+    tokens."""
+    before = engine.cache.shared_pages
+    prompt = rng.randint(0, VOCAB, 11).tolist()   # 2 full pages @ ps=4
+    a = engine.submit(prompt, 12)
+    # make sure A is RUNNING (holding its pages) when B admits
+    first = next(iter(a.stream()))
+    b = engine.submit(prompt, 12)
+    ta = [first] + [t for t in a.stream()]
+    tb = b.result(timeout=60)
+    assert ta == tb
+    assert engine.cache.shared_pages > before
+    assert b.ttft_s is not None
+
+
+# ------------------------------------------------- admission / backpressure
+def test_page_exhaustion_sheds_429_not_hang(lm):
+    """Slots full + pages pinned by long-running requests: a bounded
+    pending queue sheds new arrivals with QueueFullError (HTTP 429)
+    promptly instead of queueing unbounded or hanging."""
+    eng = GenerationEngine(lm, slots=2, page_size=4, max_context=32,
+                           max_queue=2, deadline_s=30.0)
+    eng.start()
+    try:
+        long = [eng.submit([1, 2, 3], 24) for _ in range(2)]   # fill slots
+        for h in long:                    # both RUNNING (pages pinned)
+            next(iter(h.stream()))
+        queued = [eng.submit([4, 5], 24) for _ in range(2)]    # fill queue
+        t0 = time.perf_counter()
+        with pytest.raises(QueueFullError) as ei:
+            eng.submit([6], 24)
+        assert time.perf_counter() - t0 < 1.0      # shed, not hung
+        assert ei.value.http_status == 429
+        for h in long + queued:
+            assert h.result(timeout=60)
+    finally:
+        eng.stop()
+
+
+def test_request_that_can_never_fit_rejected(engine):
+    with pytest.raises(ValueError):
+        engine.submit(list(range(20)), 1000)       # > max_context
+
+
+def test_over_bucket_prompt_rejected_at_submit(lm):
+    """A prompt longer than the largest prefill bucket must fail the
+    SUBMITTER with a clean ValueError — not detonate on the decode
+    thread and take the whole running batch down with it."""
+    eng = GenerationEngine(lm, slots=2, page_size=4, max_context=32,
+                           max_queue=8, prefill_buckets=(8,))
+    eng.start()
+    try:
+        running = eng.submit([1, 2, 3], 20)
+        with pytest.raises(ValueError):
+            eng.submit(list(range(12)), 4)         # > bucket 8
+        # the running batch was untouched by the rejection
+        assert len(running.result(timeout=60)) == 20
+    finally:
+        eng.stop()
+
+
+def test_prefill_failure_terminates_request_not_zombie(lm):
+    """A prefill that raises must FAIL the admitted request (waiters
+    released, pages freed) instead of leaving it permanently pending
+    while its pages leak."""
+    eng = GenerationEngine(lm, slots=2, page_size=4, max_context=32,
+                           max_queue=8, prefill_buckets=(8,))
+    eng.start()
+    try:
+        free0 = eng.cache.free_pages
+        mv = eng.models.active("default")
+        progs = eng._programs[mv.key]
+        orig = progs.prefill
+        boom = {"n": 0}
+
+        def exploding(*a, **kw):
+            if boom["n"] == 0:
+                boom["n"] += 1
+                raise RuntimeError("injected prefill failure")
+            return orig(*a, **kw)
+
+        progs.prefill = exploding
+        doomed = eng.submit([1, 2, 3, 4], 6)
+        with pytest.raises(RuntimeError, match="injected"):
+            doomed.result(timeout=30)              # released, not hung
+        assert doomed.finish_reason == "error"
+        # pages freed, engine recovered: next request serves normally
+        deadline = time.monotonic() + 10
+        while eng.cache.free_pages < free0 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.cache.free_pages == free0
+        assert len(eng.generate([5, 6], 4)) == 4
+    finally:
+        eng.stop()
+
+
+def test_stop_drain_timeout_sheds_503_not_504(lm):
+    """Requests still queued when the drain window closes failed because
+    the ENGINE stopped, not because their deadline passed: 503."""
+    eng = GenerationEngine(lm, slots=1, page_size=4, max_context=32,
+                           max_queue=8, deadline_s=600.0,
+                           prefill_buckets=(8,))
+    eng.start()
+    blocker = eng.submit([1, 2], 30)
+    next(iter(blocker.stream()))
+    queued = eng.submit([3, 4], 30)                # waits behind blocker
+    eng.stop(drain=True, timeout=0.01)             # drain window too short
+    with pytest.raises(Exception) as ei:
+        queued.result(timeout=10)
+    from deeplearning4j_tpu.serving.admission import ShuttingDownError
+
+    assert isinstance(ei.value, ShuttingDownError)
+    assert ei.value.http_status == 503
+
+
+def test_queued_deadline_purged_504(lm):
+    eng = GenerationEngine(lm, slots=1, page_size=4, max_context=32,
+                           max_queue=8, deadline_s=30.0,
+                           prefill_buckets=(8,))
+    eng.start()
+    try:
+        blocker = eng.submit([1, 2], 30)
+        next(iter(blocker.stream()))   # RUNNING: the only slot + all pages
+        doomed = eng.submit([3, 4], 30, deadline_s=0.02)
+        with pytest.raises(DeadlineExceededError) as ei:
+            doomed.result(timeout=30)
+        assert ei.value.http_status == 504
+        assert doomed.trace_id in str(ei.value)
+        assert blocker.result(timeout=60)
+    finally:
+        eng.stop()
+
+
+def test_cancel_frees_pages_mid_flight(engine, rng):
+    used0 = engine.cache.used_pages
+    h = engine.submit(rng.randint(0, VOCAB, 5).tolist(), 28)
+    next(iter(h.stream()))        # running
+    h.cancel()
+    h.done.wait(timeout=30)
+    assert h.finish_reason == "cancelled"
+    deadline = time.monotonic() + 10
+    while engine.cache.used_pages > used0 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert engine.cache.used_pages == used0
+
+
+def test_stop_token_ends_stream(engine, rng):
+    prompt = rng.randint(0, VOCAB, 4).tolist()
+    free = engine.generate(prompt, 12).tolist()
+    stop = free[3]
+    first = free.index(stop)      # stop may occur earlier in the stream
+    h = engine.submit(prompt, 12, stop_token=stop)
+    toks = h.result(timeout=60)
+    assert h.finish_reason == "stop"
+    assert toks == free[:first + 1]   # identical up to and incl. the stop
+
+
+# ----------------------------------------------------------- zero recompile
+def test_zero_steady_state_compiles(engine, rng):
+    mv = engine.models.active("default")
+    warm = mv.detector.compile_count
+    handles = [engine.submit(p, int(rng.randint(1, 8)),
+                             temperature=float(rng.rand() * 1.4),
+                             top_k=int(rng.randint(0, 6)) or None,
+                             seed=i)
+               for i, p in enumerate(_prompts(rng, 16))]
+    for h in handles:
+        h.result(timeout=60)
+    assert mv.detector.compile_count == warm
+    assert mv.detector.recompile_count == 0
+    progs = engine._programs[mv.key]
+    sizes = [f._cache_size() for f in progs._prefill.values()]
+    sizes.append(progs._decode._cache_size())
+    assert sizes == [1] * len(sizes)   # one REAL XLA program each
+
+
+# ----------------------------------------------------------------- hot-swap
+def test_hot_swap_zero_drops(lm, rng):
+    """Deploy a new version (different weights, same architecture) while
+    a continuous stream of requests decodes: every stream completes,
+    none error, and the registry serves the new version afterwards."""
+    eng = GenerationEngine(lm, slots=4, page_size=4, max_context=32,
+                           max_queue=64, deadline_s=60.0)
+    eng.start()
+    try:
+        stop = threading.Event()
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def client(cid):
+            r = np.random.RandomState(cid)
+            while not stop.is_set():
+                try:
+                    toks = eng.generate(
+                        r.randint(0, VOCAB, r.randint(1, 8)).tolist(),
+                        int(r.randint(2, 6)))
+                except Exception as e:    # pragma: no cover - must not happen
+                    with lock:
+                        errors.append(e)
+                    return
+                with lock:
+                    results.append(len(toks))
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(3)]
+        [t.start() for t in threads]
+        time.sleep(0.3)
+        mv2 = eng.deploy("default", small_lm(seed=777))
+        time.sleep(0.3)
+        stop.set()
+        [t.join(30) for t in threads]
+        assert not errors
+        assert len(results) > 10
+        assert eng.models.active("default").version == mv2.version == 2
+        # swapped weights actually serve: greedy output differs from v1
+        prompt = rng.randint(0, VOCAB, 6)
+        from deeplearning4j_tpu.models.decode import generate as scan_gen
+
+        assert (eng.generate(prompt, 8).tolist()
+                == scan_gen(small_lm(seed=777), prompt[None], 8,
+                            temperature=0.0)[0].tolist())
+    finally:
+        eng.stop()
+
+
+def test_incompatible_deploy_rejected(lm):
+    eng = GenerationEngine(lm, slots=2, page_size=4, max_context=16,
+                           prefill_buckets=(8,))
+    eng.start()
+    try:
+        bad = small_lm(layers=1)          # different cache geometry
+        with pytest.raises(ValueError):
+            eng.deploy("default", bad)
+        # old version still serves
+        assert eng.generate([1, 2, 3], 3).shape == (3,)
+        assert eng.models.active("default").version == 1
+    finally:
+        eng.stop()
+
+
+def test_rollback_between_steps(lm, rng):
+    eng = GenerationEngine(lm, slots=2, page_size=4, max_context=16,
+                           prefill_buckets=(8,))
+    eng.start()
+    try:
+        prompt = rng.randint(0, VOCAB, 4)
+        v1 = eng.generate(prompt, 6).tolist()
+        eng.deploy("default", small_lm(seed=31337), retain_old=True)
+        v2 = eng.generate(prompt, 6).tolist()
+        eng.rollback()
+        back = eng.generate(prompt, 6).tolist()
+        assert back == v1
+        assert v2 != v1                    # different weights really served
+    finally:
+        eng.stop()
+
+
+# --------------------------------------------------------------- drain/stop
+def test_stop_drain_serves_queued(lm):
+    eng = GenerationEngine(lm, slots=1, page_size=4, max_context=16,
+                           max_queue=8, prefill_buckets=(8,))
+    eng.start()
+    hs = [eng.submit([1, 2], 4) for _ in range(3)]
+    eng.stop(drain=True)
+    for h in hs:
+        assert len(h.result(timeout=5)) == 4
+
+
+def test_stop_no_drain_fails_fast(lm):
+    eng = GenerationEngine(lm, slots=1, page_size=4, max_context=16,
+                           max_queue=8, prefill_buckets=(8,))
+    eng.start()
+    hs = [eng.submit([1, 2], 14) for _ in range(4)]
+    eng.stop(drain=False)
+    outcomes = []
+    for h in hs:
+        try:
+            h.result(timeout=5)
+            outcomes.append("ok")
+        except Exception as e:
+            outcomes.append(type(e).__name__)
+    # nobody hangs; later arrivals are shed with the 503 error
+    assert "ShuttingDownError" in outcomes
+
+
+# -------------------------------------------------------------------- HTTP
+def test_http_generate_full_sse_and_errors(lm):
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.streaming.serving import InferenceServer
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.1).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax")).build())
+    pred = MultiLayerNetwork(conf).init()
+    gen = GenerationEngine(lm, slots=2, page_size=4, max_context=16,
+                           max_queue=8, prefill_buckets=(8,)).start()
+    srv = InferenceServer(pred, generation=gen, access_log=True)
+    port = srv.start()
+    try:
+        c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        c.request("POST", "/generate", json.dumps(
+            {"prompt": [1, 2, 3], "max_tokens": 5, "seed": 3,
+             "temperature": 0.7}),
+            {"X-Request-Id": "gen-trace-1"})
+        r = c.getresponse()
+        body = json.loads(r.read())
+        assert r.status == 200
+        assert len(body["tokens"]) == 5
+        assert body["trace_id"] == "gen-trace-1"
+        assert body["finish_reason"] == "length"
+        assert body["ttft_ms"] is not None
+
+        # SSE: one event per token + terminal done event
+        c.request("POST", "/generate", json.dumps(
+            {"prompt": [4, 5], "max_tokens": 4, "stream": True}))
+        r = c.getresponse()
+        assert r.status == 200
+        assert r.getheader("Content-Type") == "text/event-stream"
+        events = [json.loads(line[len("data: "):])
+                  for line in r.read().decode().splitlines()
+                  if line.startswith("data: ")]
+        assert len(events) == 5 and events[-1]["done"] is True
+        assert [e["index"] for e in events[:-1]] == [0, 1, 2, 3]
+        assert all(isinstance(e["token"], int) for e in events[:-1])
+        assert events[-1]["tokens"] == 4
+
+        # malformed body -> structured 400
+        c.request("POST", "/generate", json.dumps({"max_tokens": 3}))
+        r = c.getresponse()
+        assert r.status == 400 and "prompt" in json.loads(r.read())["error"]
+
+        # oversized request -> 400, not a hang
+        c.request("POST", "/generate", json.dumps(
+            {"prompt": list(range(10)), "max_tokens": 10_000}))
+        r = c.getresponse()
+        assert r.status == 400
+    finally:
+        srv.stop()
+        gen.stop()
+
+
+# ------------------------------------------------------------ observability
+def test_metrics_and_spans(lm, rng):
+    from deeplearning4j_tpu.observability import get_registry
+    from deeplearning4j_tpu.observability.tracing import get_tracer
+
+    eng = GenerationEngine(lm, slots=2, page_size=4, max_context=16,
+                           max_queue=8, prefill_buckets=(8,))
+    eng.start()
+    try:
+        h = eng.submit(rng.randint(0, VOCAB, 5).tolist(), 6,
+                       trace_id="gen-span-1")
+        h.result(timeout=60)
+        reg = get_registry()
+        assert reg.get_value("dl4j_decode_requests_total",
+                             status="length") >= 1
+        assert reg.get_value("dl4j_decode_tokens_total",
+                             model="default") >= 6
+        spans = get_tracer().spans_for_trace("gen-span-1")
+        assert any(s.name == "generation_request" for s in spans)
+        # decode steps are step_guard steps: flight events exist
+        from deeplearning4j_tpu.observability import get_flight_recorder
+        kinds = [e.kind for e in get_flight_recorder().events()]
+        assert "step_begin" in kinds
+    finally:
+        eng.stop()
