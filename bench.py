@@ -1075,6 +1075,34 @@ def _elastic_measure(k=8, windows=48, delay_mult=10.0, batch=16):
     }
 
 
+def _measure_on_virtual_mesh(fn_name: str, min_devices: int = 8):
+    """Run ``bench.<fn_name>()`` where a ``min_devices``-way mesh exists:
+    inline when the local backend is big enough, otherwise in a
+    subprocess with 8 virtual host devices (the same code path the test
+    tier uses) — the ONE owner of that env/subprocess recipe."""
+    import subprocess
+
+    import jax
+
+    if len(jax.devices()) >= min_devices:
+        return globals()[fn_name]()
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    out = subprocess.run(
+        [sys.executable, "-c",
+         f"import json, bench; print(json.dumps(bench.{fn_name}()))"],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        env=env, capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"{fn_name} subprocess failed: {out.stderr[-300:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def bench_elastic(platform, peak):
     """Elasticity payoff on record: ParallelWrapper throughput with 1-of-8
     replicas injected 10x slow, degraded mode (evict + renormalize,
@@ -1082,28 +1110,7 @@ def bench_elastic(platform, peak):
     an 8-way data mesh, so on a smaller backend the measurement runs in a
     subprocess with 8 virtual host devices (same code path the test tier
     uses)."""
-    import subprocess
-
-    import jax
-
-    if len(jax.devices()) >= 8:
-        data = _elastic_measure()
-    else:
-        env = dict(os.environ)
-        env["JAX_PLATFORMS"] = "cpu"
-        flags = env.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            env["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import json, bench; print(json.dumps(bench._elastic_measure()))"],
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-            env=env, capture_output=True, text=True, timeout=600)
-        if out.returncode != 0:
-            raise RuntimeError(
-                f"elastic subprocess failed: {out.stderr[-300:]}")
-        data = json.loads(out.stdout.strip().splitlines()[-1])
+    data = _measure_on_virtual_mesh("_elastic_measure")
     return {
         "metric": (f"Elastic DP samples/sec, 1-of-{data['replicas']} "
                    f"replicas {round(data['injected_delay_ms'] / max(data['healthy_window_ms'], 1e-9))}x slow "
@@ -1115,6 +1122,81 @@ def bench_elastic(platform, peak):
         "dtype": "float32",
         **data,
     }
+
+
+def _memory_measure(k=4, windows=6, batch=16):
+    """Measurement body for the ``observability.memory`` section (runs in
+    a subprocess with virtual devices when the local backend has fewer
+    than ``k``, same pattern as ``_elastic_measure``): a ``k``-replica
+    ``ParallelWrapper`` with Adam under a ``ShardStatsCollector`` —
+    today's replication/communication baseline on record.  The sentinels
+    dict is what ``observability/regression.py``'s doc-scoped rules pin:
+    updater-state replication == k and ~(params + moments) bytes of
+    all-reduce per averaging window, until the ZeRO PR (ROADMAP item 2)
+    flips them downward."""
+    import jax
+
+    from deeplearning4j_tpu.backend import device as backend
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.observability import shardstats
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    mesh = backend.default_mesh(data=k, devices=jax.devices()[:k])
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .updater("adam", learning_rate=0.01).list()
+            .layer(DenseLayer(n_in=32, n_out=64, activation="relu"))
+            .layer(OutputLayer(n_in=64, n_out=8, loss="mcxent",
+                               activation="softmax")).build())
+    net = MultiLayerNetwork(conf).init()
+    rs = np.random.RandomState(11)
+    x = rs.rand(k * windows * batch, 32).astype(np.float32)
+    y = np.eye(8, dtype=np.float32)[rs.randint(0, 8, len(x))]
+    with shardstats.ShardStatsCollector() as coll:
+        pw = ParallelWrapper(net, workers=k, mesh=mesh,
+                             averaging_frequency=1, average_updaters=True)
+        pw.fit(ListDataSetIterator(DataSet(x, y), batch))
+        programs = coll.programs()
+    ledger = shardstats.latest_ledgers().get("parallel_wrapper", {})
+    trees = ledger.get("trees", {})
+    prog = programs.get("ParallelWrapper.fit_window", {})
+    census = prog.get("collectives", {})
+    param_bytes = sum(
+        int(np.asarray(l).size) * 4
+        for l in jax.tree_util.tree_leaves(net.params))
+    return {
+        "replicas": k,
+        "windows": windows,
+        "ledger": ledger,
+        "programs": programs,
+        "analytic_param_bytes": param_bytes,
+        "link_bandwidth": dict(zip(
+            ("bytes_per_s", "source"), shardstats.link_bandwidth_for())),
+        # the rule-addressable scalars (doc-scoped sentinels in
+        # observability/regression.py DEFAULT_RULES)
+        "sentinels": {
+            "updater_replication_factor": (
+                trees.get("updater_state", {}).get("replication_factor")),
+            "param_replication_factor": (
+                trees.get("params", {}).get("replication_factor")),
+            "collective_bytes_per_step": prog.get("collective_bytes"),
+            "allreduce_count_per_step": (
+                census.get("all-reduce", {}).get("count")),
+            "per_device_bytes": ledger.get("total", {}).get(
+                "per_device_bytes"),
+            "comm_compute_ratio": prog.get("comm_compute_ratio"),
+        },
+    }
+
+
+def _memory_section():
+    """The ``observability.memory`` payload: ``_memory_measure`` on an
+    adequate mesh (shared virtual-mesh recipe, see
+    ``_measure_on_virtual_mesh``)."""
+    return _measure_on_virtual_mesh("_memory_measure", min_devices=4)
 
 
 def bench_online(platform, peak):
@@ -1509,6 +1591,16 @@ def main():
     if not metrics:
         raise RuntimeError("; ".join(errors) or "no metric ran")
 
+    # memory & collective-communication baselines (sharding ledger +
+    # HLO census of a 4-replica DP window) — not a throughput metric,
+    # so it rides in observability.memory instead of "all"
+    memory_section = None
+    try:
+        with phases.phase("memory"):
+            memory_section = _memory_section()
+    except Exception as e:
+        errors.append(f"memory: {str(e)[:250]}")
+
     head = metrics[0]
     full = {
         "metric": head["metric"],
@@ -1531,6 +1623,11 @@ def main():
             # MFU / step-flops / peak-memory attribution for the train
             # and decode benches (roadmap items 1/2/5 before-numbers)
             "performance": _performance_attribution(metrics, dev),
+            # sharding ledger + collective census baselines (the numbers
+            # the ZeRO PR regresses against; doc-scoped sentinel rules
+            # in observability/regression.py address
+            # observability.memory.sentinels.*)
+            "memory": memory_section,
             "registry": get_registry().to_json(),
             # diagnostics: the SLO verdict over everything the run
             # recorded, the merged per-worker view, and how much flight

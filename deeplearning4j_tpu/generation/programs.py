@@ -208,10 +208,47 @@ class GenerationPrograms:
         """AOT-compile every program on a SCRATCH pool (donation consumes
         it; the live pool is never touched) through the version's
         detector as planned compiles.  Returns the number of programs
-        warmed — after this, steady-state serving compiles nothing."""
+        warmed — after this, steady-state serving compiles nothing.
+
+        Warmup is also the memory-observability hook: the KV pool /
+        params ledger is recorded here (metadata walk), and when a
+        ``ShardStatsCollector`` is installed each program additionally
+        gets its HLO memory + collective census (abstract lowering on
+        the scratch args, BEFORE they are donated).  Cost note: the
+        census ``lower().compile()`` does not share jit's dispatch
+        cache, so a collector-on warmup compiles each program once more
+        — the same documented one-off-per-signature price the
+        ``StepProfiler`` cost-analysis seam pays (profiling.py), only
+        ever while the opt-in collector is installed."""
+        from deeplearning4j_tpu.observability import shardstats
+
         s, maxp = self.slots, self.pages_per_slot
         zeros_i = np.zeros
         pools = self.fresh_pools()
+        shardstats.record_ledger(
+            "generation",
+            {"params": self.net.params, "net_state": self.net.net_state,
+             "kv_pools": pools})
+        coll = shardstats.active_collector()
+        if coll is not None:
+            # census at the exact warmup signatures; lower-only, so the
+            # scratch pools below are still live for the real dispatches
+            coll.analyze_program(
+                self._decode, "generation.decode",
+                (self.net.params, self.net.net_state, pools,
+                 zeros_i((s, maxp), np.int32), zeros_i((s,), np.int32),
+                 zeros_i((s,), np.int32), zeros_i((s, 2), np.uint32),
+                 zeros_i((s,), np.int32), zeros_i((s,), np.float32),
+                 zeros_i((s,), np.int32), np.ones((s,), np.float32)))
+            for b in self.prefill_buckets:
+                coll.analyze_program(
+                    self._prefill[b], f"generation.prefill_{b}",
+                    (self.net.params, self.net.net_state, pools,
+                     zeros_i((1, maxp), np.int32), zeros_i((1,), np.int32),
+                     np.int32(0), zeros_i((1, b), np.int32),
+                     zeros_i((1, 2), np.uint32), zeros_i((1,), np.int32),
+                     zeros_i((1,), np.float32), zeros_i((1,), np.int32),
+                     np.ones((1,), np.float32)))
         for b in self.prefill_buckets:
             pools, _ = self.prefill(
                 b, self.net.params, self.net.net_state, pools,

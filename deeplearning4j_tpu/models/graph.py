@@ -684,13 +684,16 @@ class ComputationGraph(LazyScoreMixin):
         layer exactly as in ``MultiLayerNetwork.fit``: auto-resume with
         batch skipping, boundary saves, clean preemption stop, transient
         step retry (docs/resilience.md)."""
-        from deeplearning4j_tpu.observability import profiling
+        from deeplearning4j_tpu.observability import profiling, shardstats
 
         prof = profiling.active_profiler()
         if prof is not None:
             # memory attribution: flight/watchdog dumps show this model's
             # per-leaf param/updater byte breakdown (weakly held)
             prof.track_model(self, "ComputationGraph")
+        # sharding ledger (per-tree bytes/replication; metadata walk only,
+        # once per fit call) — flight dumps and GET /memory read it
+        shardstats.record_model_ledger(self, "ComputationGraph")
         res = None
         if checkpoint_manager is not None or retry_policy is not None:
             from deeplearning4j_tpu.resilience import FitResilience

@@ -412,13 +412,16 @@ class MultiLayerNetwork(LazyScoreMixin):
         ``PreemptionHandler`` — commits a priority checkpoint and returns
         cleanly.  ``retry_policy=`` retries transient step failures with
         backoff (docs/resilience.md)."""
-        from deeplearning4j_tpu.observability import profiling
+        from deeplearning4j_tpu.observability import profiling, shardstats
 
         prof = profiling.active_profiler()
         if prof is not None:
             # memory attribution: flight/watchdog dumps show this model's
             # per-leaf param/updater byte breakdown (weakly held)
             prof.track_model(self, "MultiLayerNetwork")
+        # sharding ledger (per-tree bytes/replication; metadata walk only,
+        # once per fit call) — flight dumps and GET /memory read it
+        shardstats.record_model_ledger(self, "MultiLayerNetwork")
         res = None
         if checkpoint_manager is not None or retry_policy is not None:
             from deeplearning4j_tpu.resilience import FitResilience

@@ -28,6 +28,12 @@ from deeplearning4j_tpu.observability.recompile import (
 from deeplearning4j_tpu.observability.memory import (
     DeviceMemoryMonitor, device_memory_stats, sample_once,
 )
+from deeplearning4j_tpu.observability.shardstats import (
+    LINK_BANDWIDTH, ShardStatsCollector, active_collector,
+    attribute_mesh_axes, collective_census, format_ledger, latest_ledgers,
+    link_bandwidth_for, program_analysis, record_ledger,
+    record_model_ledger, ring_wire_bytes, sharding_ledger,
+)
 from deeplearning4j_tpu.observability.phases import PhaseTimers
 from deeplearning4j_tpu.observability.fitmetrics import (
     FitTelemetry, fit_telemetry,
@@ -56,6 +62,11 @@ __all__ = [
     "peak_memory_snapshot",
     "RecompileDetector", "compile_counter", "fingerprint", "instrument",
     "DeviceMemoryMonitor", "device_memory_stats", "sample_once",
+    "LINK_BANDWIDTH", "ShardStatsCollector", "active_collector",
+    "attribute_mesh_axes", "collective_census", "format_ledger",
+    "latest_ledgers", "link_bandwidth_for", "program_analysis",
+    "record_ledger", "record_model_ledger", "ring_wire_bytes",
+    "sharding_ledger",
     "PhaseTimers", "FitTelemetry", "fit_telemetry", "ServingMetrics",
     "ClusterStatsAggregator", "HealthEvaluator", "HealthRule",
     "HealthVerdict", "StragglerDetector", "WorkerTelemetry",

@@ -159,6 +159,18 @@ def dump_flight_report(path: str, reason: str, *, recorder=None, tracer=None,
     except Exception as e:
         lines.append({"record": "error", "section": "memory_attribution",
                       "error": repr(e)})
+    try:
+        # WHERE the bytes live: the most recent sharding ledger per
+        # component (per-device bytes, replication factors, ZeRO
+        # projection) so an OOM-adjacent hang dump carries per-tree
+        # byte attribution in the post-mortem
+        from deeplearning4j_tpu.observability import shardstats
+
+        lines.append({"record": "sharding_ledger",
+                      "ledgers": shardstats.latest_ledgers()})
+    except Exception as e:
+        lines.append({"record": "error", "section": "sharding_ledger",
+                      "error": repr(e)})
     with open(path, "w") as f:
         for obj in lines:
             f.write(json.dumps(obj, default=str) + "\n")

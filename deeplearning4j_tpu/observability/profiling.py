@@ -104,30 +104,20 @@ def peak_flops_for(device=None) -> Tuple[float, str]:
 def jit_cost_analysis(fn, args: Tuple, kwargs: Dict) -> Dict[str, float]:
     """XLA cost analysis of ``fn`` (a jitted callable) at the ABSTRACT
     signature of ``args``/``kwargs``: every array leaf is replaced by a
-    ``ShapeDtypeStruct`` before lowering, so the concrete buffers are
-    never touched (safe with donated args) and nothing executes.  Returns
-    ``{"flops": ..., "bytes_accessed": ...}`` or ``{}`` when the backend
-    does not support cost analysis."""
-    import jax
+    ``ShapeDtypeStruct`` before lowering (input shardings preserved), so
+    the concrete buffers are never touched (safe with donated args) and
+    nothing executes.  Returns ``{"flops": ..., "bytes_accessed": ...}``
+    or ``{}`` when the backend does not support cost analysis.  Thin
+    wrapper over ``shardstats.program_analysis`` — the ONE owner of the
+    abstract-lowering recipe."""
+    from deeplearning4j_tpu.observability import shardstats
 
-    def absify(leaf):
-        shape = getattr(leaf, "shape", None)
-        dtype = getattr(leaf, "dtype", None)
-        if shape is not None and dtype is not None:
-            return jax.ShapeDtypeStruct(tuple(shape), dtype)
-        return leaf
-
-    try:
-        abs_args, abs_kwargs = jax.tree_util.tree_map(absify, (args, kwargs))
-        cost = fn.lower(*abs_args, **abs_kwargs).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        return {
-            "flops": float(cost.get("flops", 0.0) or 0.0),
-            "bytes_accessed": float(cost.get("bytes accessed", 0.0) or 0.0),
-        }
-    except Exception:
+    out = shardstats.program_analysis(fn, args, kwargs, memory=False,
+                                      collectives=False)
+    if "flops" not in out and "bytes_accessed" not in out:
         return {}
+    return {"flops": out.get("flops", 0.0),
+            "bytes_accessed": out.get("bytes_accessed", 0.0)}
 
 
 # -------------------------------------------------------- memory attribution

@@ -25,7 +25,7 @@ import logging
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from deeplearning4j_tpu.observability import profiling
+from deeplearning4j_tpu.observability import profiling, shardstats
 
 logger = logging.getLogger("deeplearning4j_tpu.observability")
 
@@ -266,9 +266,15 @@ class _InstrumentedJit:
 
     def __call__(self, *args, **kwargs):
         prof = profiling.active_profiler()
+        coll = shardstats.active_collector()
         cost_fn = None
-        if prof is not None and prof.cost_analysis:
-            fn = self._fn
+        fn = self._fn
+        if coll is not None:
+            # superset analysis: memory_analysis + collective census +
+            # the same flops/bytes fields jit_cost_analysis returns, from
+            # ONE lower+compile — an installed profiler reads it as-is
+            cost_fn = lambda: shardstats.program_analysis(fn, args, kwargs)
+        elif prof is not None and prof.cost_analysis:
             cost_fn = lambda: profiling.jit_cost_analysis(fn, args, kwargs)
         if self._argnums is None:
             self.detector.check(args, kwargs, cost_fn=cost_fn)
@@ -279,6 +285,8 @@ class _InstrumentedJit:
             self.detector.check(sel, kwargs, cost_fn=cost_fn)
         if prof is not None:
             prof.note_dispatch(self.detector.name, self.detector.last_cost)
+        if coll is not None:
+            coll.note_dispatch(self.detector.name, self.detector.last_cost)
         return self._fn(*args, **kwargs)
 
     def __getattr__(self, item):

@@ -17,6 +17,10 @@ Rule addressing: bench entries live in ``doc["all"]``, each with a
 part after `` (`` encodes the config and changes across platforms, so
 rules match on the PREFIX before it.  ``field`` is a dotted path inside
 the entry (``"value"``, ``"variants.gqa2_rolling.tokens_per_sec"``).
+With ``scope="doc"`` the rule skips the entry lookup and resolves
+``field`` from the DOCUMENT root instead — how the memory sentinels
+address ``observability.memory.sentinels.*`` (the ``metric`` string is
+then only the display name).
 """
 
 from __future__ import annotations
@@ -31,21 +35,25 @@ LOWER = "lower"     # smaller is better (latency, step time)
 class Rule:
     """One metric's regression policy."""
 
-    __slots__ = ("metric", "field", "direction", "tolerance", "required")
+    __slots__ = ("metric", "field", "direction", "tolerance", "required",
+                 "scope")
 
     def __init__(self, metric: str, field: str = "value",
                  direction: str = HIGHER, tolerance: float = 0.15,
-                 required: bool = True):
+                 required: bool = True, scope: str = "all"):
         if direction not in (HIGHER, LOWER):
             raise ValueError(
                 f"direction must be {HIGHER!r} or {LOWER!r}, got {direction!r}")
         if tolerance < 0:
             raise ValueError(f"tolerance must be >= 0, got {tolerance}")
+        if scope not in ("all", "doc"):
+            raise ValueError(f"scope must be 'all' or 'doc', got {scope!r}")
         self.metric = str(metric)
         self.field = str(field)
         self.direction = direction
         self.tolerance = float(tolerance)
         self.required = bool(required)
+        self.scope = scope
 
     @property
     def key(self) -> str:
@@ -54,19 +62,19 @@ class Rule:
     def to_dict(self) -> Dict[str, Any]:
         return {"metric": self.metric, "field": self.field,
                 "direction": self.direction, "tolerance": self.tolerance,
-                "required": self.required}
+                "required": self.required, "scope": self.scope}
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "Rule":
         unknown = set(d) - {"metric", "field", "direction", "tolerance",
-                            "required"}
+                            "required", "scope"}
         if unknown:
             raise ValueError(f"unknown rule keys: {sorted(unknown)}")
         if "metric" not in d:
             raise ValueError(f"rule needs a 'metric': {d!r}")
         return Rule(d["metric"], d.get("field", "value"),
                     d.get("direction", HIGHER), d.get("tolerance", 0.15),
-                    d.get("required", True))
+                    d.get("required", True), d.get("scope", "all"))
 
 
 # The committed policy over bench_full.json.  Tolerances are wide (0.4)
@@ -128,6 +136,28 @@ DEFAULT_RULES: List[Rule] = [
     # a collapse here means the collection fell off the fused path (or a
     # per-report host-sync storm came back).
     Rule("Introspected train step", direction=LOWER, tolerance=0.4),
+    # memory & collective-communication sentinels (bench _memory_measure
+    # -> observability.memory.sentinels): today a K-replica data-parallel
+    # run replicates the updater state K times and moves ~(params +
+    # moments) bytes of all-reduce per averaging window — these rules pin
+    # that baseline so any accidental growth fails CI, and the ZeRO PR
+    # (ROADMAP item 2) lands as a measured IMPROVEMENT (factor K -> ~1)
+    # instead of a guess.  direction=lower + tolerance=0 means "any
+    # increase regresses, any decrease improves".  Optional because the
+    # section needs the 8-device virtual mesh (subprocess, like the
+    # elastic bench).
+    Rule("Memory: updater replication (4-replica DP)", scope="doc",
+         field="observability.memory.sentinels.updater_replication_factor",
+         direction=LOWER, tolerance=0.0, required=False),
+    Rule("Memory: param replication (4-replica DP)", scope="doc",
+         field="observability.memory.sentinels.param_replication_factor",
+         direction=LOWER, tolerance=0.0, required=False),
+    Rule("Memory: collective bytes/step (4-replica DP)", scope="doc",
+         field="observability.memory.sentinels.collective_bytes_per_step",
+         direction=LOWER, tolerance=0.25, required=False),
+    Rule("Memory: per-device train bytes (4-replica DP)", scope="doc",
+         field="observability.memory.sentinels.per_device_bytes",
+         direction=LOWER, tolerance=0.25, required=False),
 ]
 
 
@@ -160,6 +190,8 @@ def _get_field(entry: Dict[str, Any], dotted: str) -> Optional[float]:
 
 
 def extract(doc: Dict[str, Any], rule: Rule) -> Optional[float]:
+    if rule.scope == "doc":
+        return _get_field(doc, rule.field)
     entry = _find_entry(doc, rule.metric)
     if entry is None:
         return None

@@ -39,6 +39,7 @@ from deeplearning4j_tpu.backend import device as backend
 from deeplearning4j_tpu.observability import (
     PhaseTimers, WorkerTelemetry, get_registry, instrument, step_guard,
 )
+from deeplearning4j_tpu.observability import shardstats
 from deeplearning4j_tpu.optimize import updaters as upd
 from deeplearning4j_tpu.parallel.elastic import ElasticConfig, ElasticController
 
@@ -401,6 +402,18 @@ class ParallelWrapper:
         params_k = jax.device_put(params_k, shard)
         upd_k = jax.device_put(upd_k, shard) if net.updater_state else upd_k
         ns_k = jax.device_put(ns_k, shard) if net.net_state else ns_k
+        # sharding ledger over the stacked replica view, measured against
+        # the facade's single-model trees: full replication reads K here
+        # — the baseline the ZeRO update sharding (ROADMAP item 2) will
+        # drive toward 1 for the updater-state row.  Metadata walk only;
+        # recorded once per fit, before the first (donating) dispatch.
+        shardstats.record_ledger(
+            "parallel_wrapper",
+            {"params": params_k, "updater_state": upd_k, "net_state": ns_k},
+            logical_trees={"params": net.params,
+                           "updater_state": net.updater_state,
+                           "net_state": net.net_state},
+            data_axis_size=K)
 
         if (isinstance(iterator, ListDataSetIterator)
                 and iterator._data.features_mask is None

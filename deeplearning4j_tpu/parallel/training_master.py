@@ -41,6 +41,7 @@ from deeplearning4j_tpu.backend import device as backend
 from deeplearning4j_tpu.observability import (
     PhaseTimers, WorkerTelemetry, crash_dump, instrument, step_guard,
 )
+from deeplearning4j_tpu.observability import shardstats
 from deeplearning4j_tpu.optimize import updaters as upd
 from deeplearning4j_tpu.parallel.elastic import ElasticConfig, ElasticController
 
@@ -334,6 +335,17 @@ class SyncTrainingMaster(TrainingMaster):
         upd_state = jax.device_put(net.updater_state, self._upd_layout)
         ns = jax.device_put(net.net_state, self._repl_sharding)
         K = self.mesh.shape[backend.AXIS_DATA]
+        # sharding ledger under the master's actual layouts: replicated
+        # params/updater read factor = mesh size — the measured baseline
+        # the ZeRO update sharding (ROADMAP item 2) regresses against.
+        # Metadata walk only, before the first (donating) dispatch.
+        # Component matches the rest of this loop's telemetry (step_guard
+        # and PhaseStats label "sync_master" for subclasses too, so the
+        # ledger stays joinable with the step metrics).
+        shardstats.record_ledger(
+            "sync_master",
+            {"params": params, "updater_state": upd_state, "net_state": ns},
+            data_axis_size=K)
         it = iter(iterator)
         while True:
             # phases ≙ CommonSparkTrainingStats: fetch (split/repartition),

@@ -155,6 +155,11 @@ class UIServer:
       a recompile budget, plus whatever step-p99/throughput/straggler
       limits the caller configures); 200 healthy / 503 with the failing
       rules detailed.
+    - ``GET /memory`` — the sharding ledger (per-tree per-device bytes,
+      replication factors, ZeRO projection) plus per-program memory /
+      collective accounting when a ``ShardStatsCollector`` is installed,
+      and the PJRT device stats (docs/observability.md "Memory &
+      communication").
     """
 
     def __init__(self, storage: Optional[StatsStorage] = None, port: int = 0,
@@ -426,6 +431,23 @@ class UIServer:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif path == "/memory":
+                    # the sharding ledger + per-program memory/collective
+                    # accounting (docs/observability.md "Memory &
+                    # communication"); device stats ride along so one
+                    # probe answers "what holds the HBM and why"
+                    from deeplearning4j_tpu.observability import shardstats
+                    from deeplearning4j_tpu.observability.memory import (
+                        device_memory_stats,
+                    )
+
+                    coll = shardstats.active_collector()
+                    self._json({
+                        "ledgers": shardstats.latest_ledgers(),
+                        "programs": (coll.programs() if coll is not None
+                                     else {}),
+                        "device_memory": device_memory_stats(),
+                    })
                 elif path == "/health":
                     verdict = ui.health.evaluate()
                     self._json(verdict.to_dict(),

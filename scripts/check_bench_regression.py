@@ -106,6 +106,24 @@ def _self_test(reg) -> int:
     rep = reg.compare(vb, vf, [R("Decode", field="variants.fast.tps",
                                  tolerance=0.2)])
     expect(rep.exit_code == 1, "dotted-field regression not flagged")
+    # doc-scoped rules resolve from the document root (the memory
+    # sentinels): replication factor growing past a zero tolerance fails,
+    # the ZeRO-style drop reads as an improvement
+    mb = {"all": [], "observability": {"memory": {"sentinels": {
+        "updater_replication_factor": 4.0}}}}
+    mf_worse = {"all": [], "observability": {"memory": {"sentinels": {
+        "updater_replication_factor": 8.0}}}}
+    mf_zero = {"all": [], "observability": {"memory": {"sentinels": {
+        "updater_replication_factor": 1.0}}}}
+    doc_rule = R("Memory: updater replication", scope="doc",
+                 field="observability.memory.sentinels."
+                       "updater_replication_factor",
+                 direction=reg.LOWER, tolerance=0.0, required=False)
+    rep = reg.compare(mb, mf_worse, [doc_rule])
+    expect(rep.exit_code == 1, "replication-factor growth passed")
+    rep = reg.compare(mb, mf_zero, [doc_rule])
+    expect(rep.verdicts[0].status == "improved",
+           "ZeRO-style replication drop not labeled improved")
     # rule JSON round-trip + validation errors
     r = R("Throughput", field="p99_ms", direction=reg.LOWER, tolerance=0.3,
           required=False)
