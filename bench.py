@@ -1128,12 +1128,12 @@ def _memory_measure(k=4, windows=6, batch=16):
     """Measurement body for the ``observability.memory`` section (runs in
     a subprocess with virtual devices when the local backend has fewer
     than ``k``, same pattern as ``_elastic_measure``): a ``k``-replica
-    ``ParallelWrapper`` with Adam under a ``ShardStatsCollector`` —
-    today's replication/communication baseline on record.  The sentinels
-    dict is what ``observability/regression.py``'s doc-scoped rules pin:
-    updater-state replication == k and ~(params + moments) bytes of
-    all-reduce per averaging window, until the ZeRO PR (ROADMAP item 2)
-    flips them downward."""
+    ``ParallelWrapper`` with Adam under a ``ShardStatsCollector``, in
+    BOTH update-sharding modes — the replicated arm is the before, the
+    ZeRO arm (update sharding landed, ROADMAP item 2 / arXiv 2004.13336)
+    is the baseline the sentinels now pin: updater-state replication ~1,
+    all-to-all/all-gather wire bytes at or below the old all-reduce, and
+    ZERO steady-state recompiles of the sharded window."""
     import jax
 
     from deeplearning4j_tpu.backend import device as backend
@@ -1142,52 +1142,97 @@ def _memory_measure(k=4, windows=6, batch=16):
     from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
     from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
     from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
-    from deeplearning4j_tpu.observability import shardstats
+    from deeplearning4j_tpu.observability import get_registry, shardstats
     from deeplearning4j_tpu.parallel import ParallelWrapper
 
     mesh = backend.default_mesh(data=k, devices=jax.devices()[:k])
-    conf = (NeuralNetConfiguration.builder().seed(7)
-            .updater("adam", learning_rate=0.01).list()
-            .layer(DenseLayer(n_in=32, n_out=64, activation="relu"))
-            .layer(OutputLayer(n_in=64, n_out=8, loss="mcxent",
-                               activation="softmax")).build())
-    net = MultiLayerNetwork(conf).init()
     rs = np.random.RandomState(11)
     x = rs.rand(k * windows * batch, 32).astype(np.float32)
     y = np.eye(8, dtype=np.float32)[rs.randint(0, 8, len(x))]
-    with shardstats.ShardStatsCollector() as coll:
-        pw = ParallelWrapper(net, workers=k, mesh=mesh,
-                             averaging_frequency=1, average_updaters=True)
-        pw.fit(ListDataSetIterator(DataSet(x, y), batch))
-        programs = coll.programs()
-    ledger = shardstats.latest_ledgers().get("parallel_wrapper", {})
-    trees = ledger.get("trees", {})
-    prog = programs.get("ParallelWrapper.fit_window", {})
-    census = prog.get("collectives", {})
-    param_bytes = sum(
-        int(np.asarray(l).size) * 4
-        for l in jax.tree_util.tree_leaves(net.params))
-    return {
-        "replicas": k,
-        "windows": windows,
-        "ledger": ledger,
-        "programs": programs,
-        "analytic_param_bytes": param_bytes,
-        "link_bandwidth": dict(zip(
-            ("bytes_per_s", "source"), shardstats.link_bandwidth_for())),
-        # the rule-addressable scalars (doc-scoped sentinels in
-        # observability/regression.py DEFAULT_RULES)
-        "sentinels": {
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .updater("adam", learning_rate=0.01).list()
+                .layer(DenseLayer(n_in=32, n_out=64, activation="relu"))
+                .layer(OutputLayer(n_in=64, n_out=8, loss="mcxent",
+                                   activation="softmax")).build())
+        return MultiLayerNetwork(conf).init()
+
+    def run_arm(update_sharding):
+        net = build_net()
+        with shardstats.ShardStatsCollector() as coll:
+            pw = ParallelWrapper(net, workers=k, mesh=mesh,
+                                 averaging_frequency=1,
+                                 average_updaters=True,
+                                 update_sharding=update_sharding)
+            pw.fit(ListDataSetIterator(DataSet(x, y), batch))
+            # steady state: a second fit over identical shapes must
+            # add zero compiles (the exact-zero sentinel)
+            c0 = get_registry().family_total("dl4j_compiles_total")
+            pw.fit(ListDataSetIterator(DataSet(x, y), batch))
+            steady = (get_registry().family_total("dl4j_compiles_total")
+                      - c0)
+            programs = coll.programs()
+        ledger = shardstats.latest_ledgers().get("parallel_wrapper", {})
+        trees = ledger.get("trees", {})
+        fn = ("ParallelWrapper.fit_window_zero"
+              if update_sharding == "zero" else "ParallelWrapper.fit_window")
+        prog = programs.get(fn, {})
+        return {
+            "update_sharding": update_sharding,
+            "window_program": fn,
+            "ledger": ledger,
+            "programs": programs,
+            "steady_state_compiles": steady,
             "updater_replication_factor": (
                 trees.get("updater_state", {}).get("replication_factor")),
             "param_replication_factor": (
                 trees.get("params", {}).get("replication_factor")),
             "collective_bytes_per_step": prog.get("collective_bytes"),
-            "allreduce_count_per_step": (
-                census.get("all-reduce", {}).get("count")),
+            "wire_bytes_per_step": prog.get("wire_bytes_per_device"),
             "per_device_bytes": ledger.get("total", {}).get(
                 "per_device_bytes"),
             "comm_compute_ratio": prog.get("comm_compute_ratio"),
+            "collectives": prog.get("collectives"),
+        }
+
+    replicated = run_arm("replicated")
+    zero = run_arm("zero")
+    census = zero.get("collectives") or {}
+    param_bytes = (zero.get("ledger", {}).get("trees", {})
+                   .get("params", {}).get("logical_bytes"))
+    return {
+        "replicas": k,
+        "windows": windows,
+        "replicated": replicated,
+        "zero": zero,
+        "analytic_param_bytes": param_bytes,
+        "link_bandwidth": dict(zip(
+            ("bytes_per_s", "source"), shardstats.link_bandwidth_for())),
+        # the rule-addressable scalars (doc-scoped sentinels in
+        # observability/regression.py DEFAULT_RULES) — flipped to the
+        # SHARDED baselines by the ZeRO PR; the replicated_* fields keep
+        # the before-numbers on record for the comparison
+        "sentinels": {
+            "updater_replication_factor": (
+                zero["updater_replication_factor"]),
+            "param_replication_factor": zero["param_replication_factor"],
+            "collective_bytes_per_step": zero["collective_bytes_per_step"],
+            "wire_bytes_per_step": zero["wire_bytes_per_step"],
+            "per_device_bytes": zero["per_device_bytes"],
+            "comm_compute_ratio": zero["comm_compute_ratio"],
+            "allreduce_count_per_step": (
+                census.get("all-reduce", {}).get("count", 0)),
+            "all_gather_count_per_step": (
+                census.get("all-gather", {}).get("count", 0)),
+            "all_to_all_count_per_step": (
+                census.get("all-to-all", {}).get("count", 0)),
+            "zero_steady_state_recompiles": zero["steady_state_compiles"],
+            "replicated_updater_replication_factor": (
+                replicated["updater_replication_factor"]),
+            "replicated_wire_bytes_per_step": (
+                replicated["wire_bytes_per_step"]),
+            "replicated_per_device_bytes": replicated["per_device_bytes"],
         },
     }
 
@@ -1197,6 +1242,111 @@ def _memory_section():
     adequate mesh (shared virtual-mesh recipe, see
     ``_measure_on_virtual_mesh``)."""
     return _measure_on_virtual_mesh("_memory_measure", min_devices=4)
+
+
+def _zero_measure(k=4, steps=24, batch=64):
+    """bench_zero body: replicated vs ZeRO update sharding on the sync
+    master at fixed per-chip memory — a dense Adam net big enough that
+    the moments dominate, same global batch in both arms.  Reports
+    steady-state step time and the ledger's per-device train-state
+    bytes for each arm (the memory headroom ZeRO buys back)."""
+    import time as _time
+
+    import jax
+
+    from deeplearning4j_tpu.backend import device as backend
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.iterator import ListDataSetIterator
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.observability import shardstats
+    from deeplearning4j_tpu.parallel import (
+        DistributedNetwork, SyncTrainingMaster,
+    )
+
+    mesh = backend.default_mesh(data=k, devices=jax.devices()[:k])
+    hidden = 512
+    rs = np.random.RandomState(13)
+    x = rs.rand(steps * batch, 64).astype(np.float32)
+    y = np.eye(16, dtype=np.float32)[rs.randint(0, 16, len(x))]
+
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(9)
+                .updater("adam", learning_rate=0.01).list()
+                .layer(DenseLayer(n_in=64, n_out=hidden,
+                                  activation="relu"))
+                .layer(DenseLayer(n_in=hidden, n_out=hidden,
+                                  activation="relu"))
+                .layer(OutputLayer(n_in=hidden, n_out=16, loss="mcxent",
+                                   activation="softmax")).build())
+        return MultiLayerNetwork(conf).init()
+
+    arms = {}
+    n_params = 0
+    for mode in ("replicated", "zero"):
+        net = build_net()
+        n_params = sum(int(np.asarray(l).size)
+                       for l in jax.tree_util.tree_leaves(net.params))
+        master = SyncTrainingMaster(mesh=mesh, update_sharding=mode)
+        dn = DistributedNetwork(net, master)
+        # warm the compile, then time a steady-state epoch
+        dn.fit(ListDataSetIterator(DataSet(x[:2 * batch], y[:2 * batch]),
+                                   batch))
+        t0 = _time.perf_counter()
+        dn.fit(ListDataSetIterator(DataSet(x, y), batch))
+        jax.block_until_ready(net.params)
+        dt = _time.perf_counter() - t0
+        ledger = shardstats.latest_ledgers().get("sync_master", {})
+        arms[mode] = {
+            "step_ms": round(dt / steps * 1e3, 3),
+            "per_device_bytes": ledger.get("total", {}).get(
+                "per_device_bytes"),
+            "updater_replication_factor": (
+                ledger.get("trees", {}).get("updater_state", {})
+                .get("replication_factor")),
+        }
+    return {
+        "replicas": k,
+        "batch": batch,
+        "params": n_params,
+        "zero_step_ms": arms["zero"]["step_ms"],
+        "replicated_step_ms": arms["replicated"]["step_ms"],
+        "zero_per_device_bytes": arms["zero"]["per_device_bytes"],
+        "replicated_per_device_bytes": (
+            arms["replicated"]["per_device_bytes"]),
+        "per_device_bytes_ratio": round(
+            arms["zero"]["per_device_bytes"]
+            / max(arms["replicated"]["per_device_bytes"], 1), 4),
+        "zero_updater_replication_factor": (
+            arms["zero"]["updater_replication_factor"]),
+        "step_time_ratio": round(arms["zero"]["step_ms"]
+                                 / max(arms["replicated"]["step_ms"],
+                                       1e-9), 3),
+    }
+
+
+def bench_zero(platform, peak):
+    """ZeRO update sharding on record (ROADMAP item 2, arXiv
+    2004.13336): step time and per-device train-state bytes of the sync
+    master with update_sharding="zero" vs replicated, at fixed per-chip
+    memory.  On the CPU tier the wire win is invisible (collectives are
+    memcpys) — the headline here is the per-device state dropping to
+    ~1/K while the step stays in the same band; the HLO-census sentinels
+    in ``observability.memory`` pin the collective decomposition
+    itself."""
+    data = _measure_on_virtual_mesh("_zero_measure", min_devices=4)
+    return {
+        "metric": (f"ZeRO DP step time (K={data['replicas']}, adam, "
+                   f"{data['params'] / 1e3:.0f}k params, "
+                   f"b{data['batch']})"),
+        "value": data["zero_step_ms"],
+        "unit": "ms",
+        "vs_baseline": None,
+        "data": "synthetic",
+        "dtype": "float32",
+        **data,
+    }
 
 
 def bench_online(platform, peak):
@@ -1580,6 +1730,7 @@ def main():
             ("serving", lambda: bench_serving(platform, peak)),
             ("checkpoint", lambda: bench_checkpoint(platform, peak)),
             ("elastic", lambda: bench_elastic(platform, peak)),
+            ("zero", lambda: bench_zero(platform, peak)),
             ("online", lambda: bench_online(platform, peak)),
             ("stability", lambda: bench_stability(platform, peak)),
             ("introspection", lambda: bench_introspection(platform, peak))):

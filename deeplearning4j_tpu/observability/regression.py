@@ -137,27 +137,46 @@ DEFAULT_RULES: List[Rule] = [
     # per-report host-sync storm came back).
     Rule("Introspected train step", direction=LOWER, tolerance=0.4),
     # memory & collective-communication sentinels (bench _memory_measure
-    # -> observability.memory.sentinels): today a K-replica data-parallel
-    # run replicates the updater state K times and moves ~(params +
-    # moments) bytes of all-reduce per averaging window — these rules pin
-    # that baseline so any accidental growth fails CI, and the ZeRO PR
-    # (ROADMAP item 2) lands as a measured IMPROVEMENT (factor K -> ~1)
-    # instead of a guess.  direction=lower + tolerance=0 means "any
-    # increase regresses, any decrease improves".  Optional because the
-    # section needs the 8-device virtual mesh (subprocess, like the
-    # elastic bench).
-    Rule("Memory: updater replication (4-replica DP)", scope="doc",
+    # -> observability.memory.sentinels): FLIPPED to the ZeRO baselines
+    # by the update-sharding PR (ROADMAP item 2, arXiv 2004.13336) — the
+    # sentinels now pin the SHARDED numbers: updater-state replication
+    # ~1 (was K), params ~1, the window's collective/wire bytes in the
+    # all-to-all + all-gather decomposition (at or below the old
+    # all-reduce wire bytes), and per-device train-state bytes at the
+    # sharded level.  direction=lower + tolerance=0 means "any increase
+    # regresses" — a change that silently knocks the wrapper back to
+    # replicated updater state fails the replication rule immediately.
+    # Optional because the section needs the virtual mesh (subprocess,
+    # like the elastic bench).
+    Rule("Memory: updater replication (4-replica DP, ZeRO)", scope="doc",
          field="observability.memory.sentinels.updater_replication_factor",
          direction=LOWER, tolerance=0.0, required=False),
-    Rule("Memory: param replication (4-replica DP)", scope="doc",
+    Rule("Memory: param replication (4-replica DP, ZeRO)", scope="doc",
          field="observability.memory.sentinels.param_replication_factor",
          direction=LOWER, tolerance=0.0, required=False),
-    Rule("Memory: collective bytes/step (4-replica DP)", scope="doc",
+    Rule("Memory: collective bytes/step (4-replica DP, ZeRO)", scope="doc",
          field="observability.memory.sentinels.collective_bytes_per_step",
          direction=LOWER, tolerance=0.25, required=False),
-    Rule("Memory: per-device train bytes (4-replica DP)", scope="doc",
+    Rule("Memory: wire bytes/step (4-replica DP, ZeRO)", scope="doc",
+         field="observability.memory.sentinels.wire_bytes_per_step",
+         direction=LOWER, tolerance=0.25, required=False),
+    Rule("Memory: per-device train bytes (4-replica DP, ZeRO)", scope="doc",
          field="observability.memory.sentinels.per_device_bytes",
          direction=LOWER, tolerance=0.25, required=False),
+    # the ZeRO window's zero-steady-state-recompile contract: the
+    # baseline is EXACTLY 0, so any steady-state compile of the sharded
+    # window regresses regardless of tolerance (0 * (1+tol) == 0)
+    Rule("Memory: ZeRO window steady-state recompiles", scope="doc",
+         field=("observability.memory.sentinels"
+                ".zero_steady_state_recompiles"),
+         direction=LOWER, tolerance=0.0, required=False),
+    # bench_zero: ZeRO step time must stay in the replicated band (the
+    # sharded update + gather must not fall off the fused path), and the
+    # per-device-bytes ratio guards the memory win itself (~(2+K)/(3K)
+    # for adam; a ratio drifting toward 1 means the sharding fell off)
+    Rule("ZeRO DP step time", direction=LOWER, tolerance=0.4),
+    Rule("ZeRO DP step time", field="per_device_bytes_ratio",
+         direction=LOWER, tolerance=0.1, required=False),
 ]
 
 

@@ -311,6 +311,52 @@ def program_analysis(fn, args: Tuple, kwargs: Dict, *,
 
 
 # ------------------------------------------------------------------ ledger
+# Reserved updater-state subtrees the ZeRO update sharding keeps
+# REPLICATED (stacked per replica in the wrapper): the stability engine's
+# guard/scale scalars and the introspection stat vectors.  Mirrors
+# ``resilience.stability.STATE_KEY`` / ``observability.introspection
+# .STATE_KEY`` — literals here so the ledger stays importable without
+# jax; ``tests/test_zero.py`` pins the mirror.
+RESERVED_REPLICATED_SUBTREES = ("__stability__", "__introspect__")
+
+
+def zero_shardable(shape, k: int) -> bool:
+    """Whether a leaf of ``shape`` participates in ZeRO update sharding
+    over a ``k``-way data axis: its leading dimension must exist and
+    divide evenly (a non-dividing leaf stays replicated — padding a
+    shard would change the updater's elementwise math for schedules
+    that read positions).  The ONE owner of the predicate: the
+    projected-ZeRO ledger column and ``parallel.zero``'s actual layout
+    both call this, which is what makes the projection testable against
+    the real thing."""
+    shape = tuple(shape)
+    return (k > 1 and len(shape) >= 1 and shape[0] > 0
+            and shape[0] % k == 0)
+
+
+def _projected_zero_bytes(tree, k: int, reserved: bool = False) -> int:
+    """Per-device bytes of ONE logical copy of ``tree`` under ZeRO
+    update sharding: shardable leaves contribute 1/k of their bytes,
+    non-dividing leaves and reserved subtrees (``__stability__`` /
+    ``__introspect__``) stay replicated and contribute in full.  Walks
+    shape/dtype metadata only."""
+    import jax
+
+    total = 0
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        acc = _leaf_accounting(leaf)
+        if acc is None:
+            continue
+        top = getattr(path[0], "key", None) if path else None
+        if (reserved or top in RESERVED_REPLICATED_SUBTREES
+                or not zero_shardable(getattr(leaf, "shape", ()), k)):
+            total += acc["global"]
+        else:
+            total += -(-acc["global"] // k)          # ceil
+    return total
+
+
 def _leaf_accounting(leaf) -> Optional[Dict[str, Any]]:
     """Shape/dtype/sharding metadata of one leaf — NEVER reads a buffer.
     None for non-array leaves (python scalars ride replicated for free)."""
@@ -341,7 +387,8 @@ def _leaf_accounting(leaf) -> Optional[Dict[str, Any]]:
 
 
 def _tree_row(tree, logical_tree=None,
-              data_axis_size: Optional[int] = None) -> Dict[str, Any]:
+              data_axis_size: Optional[int] = None,
+              reserved: bool = False) -> Dict[str, Any]:
     """One ledger row: aggregate byte accounting of a pytree under its
     actual shardings.  ``logical_tree`` is the SINGLE-MODEL tree when
     ``tree`` is a stacked replica view (ParallelWrapper's [K, ...]
@@ -379,9 +426,16 @@ def _tree_row(tree, logical_tree=None,
     k = data_axis_size or ndev
     if logical and k > 1:
         # projected-ZeRO column (arXiv 2004.13336): one logical copy
-        # reduce-scattered over the data axis — the per-device bytes the
-        # ZeRO PR should land at, and the saving vs today
-        projected = int(-(-logical // k))          # ceil
+        # under ZeRO update sharding over the data axis — per LEAF, so
+        # non-dividing leaves and the reserved replicated subtrees
+        # project at full size exactly as ``parallel.zero`` lays them
+        # out (the projection-vs-actual test in tests/test_zero.py
+        # holds the two to each other).  Walked over the LOGICAL tree
+        # when one is given (the stacked wrapper view's leaves carry a
+        # leading replica axis that must not drive the predicate).
+        projected = _projected_zero_bytes(
+            logical_tree if logical_tree is not None else tree, k,
+            reserved=reserved)
         row["zero_projected_per_device_bytes"] = projected
         row["zero_savings_per_device_bytes"] = per_dev - projected
     return row
@@ -409,7 +463,9 @@ def sharding_ledger(trees: Dict[str, Any],
             for key, sub in tree.items():
                 sub_logical = (logical.get(key)
                                if isinstance(logical, dict) else None)
-                subs[str(key)] = _tree_row(sub, sub_logical, data_axis_size)
+                subs[str(key)] = _tree_row(
+                    sub, sub_logical, data_axis_size,
+                    reserved=key in RESERVED_REPLICATED_SUBTREES)
             if subs:
                 row["subtrees"] = subs
         out["trees"][name] = row
@@ -463,7 +519,8 @@ _ledgers: Dict[str, Dict[str, Any]] = {}
 def record_ledger(component: str, trees: Dict[str, Any],
                   logical_trees: Optional[Dict[str, Any]] = None,
                   data_axis_size: Optional[int] = None,
-                  registry=None) -> Dict[str, Any]:
+                  registry=None,
+                  notes: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Compute the ledger, mirror the per-tree rows into
     ``dl4j_sharded_bytes`` / ``dl4j_replication_factor`` gauges, stash
     it for ``latest_ledgers()`` (flight dumps, ``GET /memory``, bench),
@@ -475,7 +532,7 @@ def record_ledger(component: str, trees: Dict[str, Any],
     flight-dump sections)."""
     try:
         return _record_ledger(component, trees, logical_trees,
-                              data_axis_size, registry)
+                              data_axis_size, registry, notes)
     except Exception:
         logging.getLogger("deeplearning4j_tpu.observability").debug(
             "sharding ledger for %s failed", component, exc_info=True)
@@ -483,11 +540,16 @@ def record_ledger(component: str, trees: Dict[str, Any],
 
 
 def _record_ledger(component, trees, logical_trees, data_axis_size,
-                   registry) -> Dict[str, Any]:
+                   registry, notes=None) -> Dict[str, Any]:
     from deeplearning4j_tpu.observability.metrics import get_registry
 
     ledger = sharding_ledger(trees, logical_trees, data_axis_size)
     ledger["component"] = str(component)
+    if notes:
+        # layout provenance (e.g. update_sharding="zero" and which
+        # reserved subtrees stayed replicated) — the operator-facing
+        # record the ZeRO docs promise
+        ledger["notes"] = dict(notes)
     reg = registry if registry is not None else get_registry()
     g_bytes = reg.gauge(
         _SHARDED_BYTES, "Per-device bytes of a tracked pytree under its "
@@ -496,8 +558,8 @@ def _record_ledger(component, trees, logical_trees, data_axis_size,
     g_repl = reg.gauge(
         _REPLICATION, "Replication factor of a tracked pytree: bytes "
         "stored across all devices / bytes of one logical copy (K for "
-        "K-replica data parallel; the ZeRO PR drives the updater-state "
-        "row toward 1)", labels=("component", "tree"))
+        "K-replica replicated data parallel, ~1 under "
+        "update_sharding='zero')", labels=("component", "tree"))
     for name, row in ledger["trees"].items():
         g_bytes.set(row["per_device_bytes"], component=component, tree=name)
         g_repl.set(row["replication_factor"], component=component, tree=name)

@@ -137,6 +137,41 @@ def init_state(cfg: UpdaterConfig, params):
     raise ValueError(f"Unknown updater '{cfg.name}'")
 
 
+def _flat(d, prefix=()):
+    """Flatten a layer's (possibly nested — composite layers) param dict
+    to {tuple-path: leaf}."""
+    out = {}
+    for k, v in d.items():
+        if isinstance(v, dict):
+            out.update(_flat(v, prefix + (k,)))
+        else:
+            out[prefix + (k,)] = v
+    return out
+
+
+def _unflat(flat):
+    out = {}
+    for path, v in flat.items():
+        cur = out
+        for k in path[:-1]:
+            cur = cur.setdefault(k, {})
+        cur[path[-1]] = v
+    return out
+
+
+def normalize_tree(cfg: UpdaterConfig, grads):
+    """Apply the configured per-layer gradient normalization to a whole
+    gradient tree — the same flatten/normalize walk ``update`` performs
+    internally, exposed for callers that must normalize on the FULL
+    per-layer gradients BEFORE scattering them into shards
+    (``parallel/zero.py``: shard-local norms would be wrong) and then
+    run ``update`` with normalization disabled."""
+    if cfg.gradient_normalization == "none":
+        return grads
+    return {lname: _unflat(normalize_gradients(cfg, _flat(lgrads)))
+            for lname, lgrads in grads.items()}
+
+
 def update(
     cfg: UpdaterConfig,
     grads,
@@ -161,24 +196,6 @@ def update(
             "params= to updaters.update() (all facade train steps do)")
     mu = current_momentum(cfg, iteration)
     it = jnp.asarray(iteration, jnp.float32)
-
-    def _flat(d, prefix=()):
-        out = {}
-        for k, v in d.items():
-            if isinstance(v, dict):
-                out.update(_flat(v, prefix + (k,)))
-            else:
-                out[prefix + (k,)] = v
-        return out
-
-    def _unflat(flat):
-        out = {}
-        for path, v in flat.items():
-            cur = out
-            for k in path[:-1]:
-                cur = cur.setdefault(k, {})
-            cur[path[-1]] = v
-        return out
 
     new_state = {k: {} for k in state}
     updates = {}

@@ -41,6 +41,7 @@ from deeplearning4j_tpu.observability import (
 )
 from deeplearning4j_tpu.observability import shardstats
 from deeplearning4j_tpu.optimize import updaters as upd
+from deeplearning4j_tpu.parallel import zero as zero_mod
 from deeplearning4j_tpu.parallel.elastic import ElasticConfig, ElasticController
 
 
@@ -157,6 +158,7 @@ class ParallelWrapper:
         checkpoint_manager=None,
         retry_policy=None,
         elastic=False,
+        update_sharding: str = zero_mod.REPLICATED,
     ):
         self.net = net
         # resilience wiring (docs/resilience.md): auto-resume on fit entry,
@@ -197,6 +199,34 @@ class ParallelWrapper:
         self._elastic: Optional[ElasticController] = None
         self._ones_w: Optional[np.ndarray] = None
         self._stab_rt = None   # StabilityRuntime (net.conf.stability)
+        # ZeRO update sharding (arXiv 2004.13336, docs/PARALLELISM.md
+        # "ZeRO"): persistent params + updater state live sharded 1/K
+        # per device; each window all-gathers the params, computes
+        # per-replica gradients, moves every replica's gradient shard to
+        # its owner (an all-to-all — the wrapper's averaging semantics
+        # need each replica's OWN gradient because the per-replica Adam
+        # updates it averages are nonlinear in them; same wire bytes as
+        # a reduce-scatter), and applies the weighted-average update to
+        # the local shard.  Restricted to averaging_frequency=1 +
+        # average_updaters=True: higher frequencies are local SGD, where
+        # every replica needs its own full moments between averages —
+        # there is nothing shardable.
+        self.update_sharding = zero_mod.validate_mode(update_sharding,
+                                                      self.mesh)
+        self._zero_layout: Optional[zero_mod.ZeroLayout] = None
+        if self.update_sharding == zero_mod.ZERO:
+            if self.averaging_frequency != 1:
+                raise ValueError(
+                    "update_sharding='zero' requires averaging_frequency"
+                    f"=1 (got {self.averaging_frequency}): local-SGD "
+                    "windows need full per-replica updater state between "
+                    "averages")
+            if not self.average_updaters:
+                raise ValueError(
+                    "update_sharding='zero' requires average_updaters="
+                    "True: un-averaged updater state is per-replica and "
+                    "cannot be sharded")
+            self._zero_layout = zero_mod.ZeroLayout(self.mesh, self.workers)
         if isinstance(elastic, ElasticController):
             if elastic.K != self.workers:
                 raise ValueError(
@@ -223,6 +253,8 @@ class ParallelWrapper:
         return NamedSharding(self.mesh, P(backend.AXIS_DATA))
 
     def _build(self):
+        if self.update_sharding == zero_mod.ZERO:
+            return self._build_zero()
         from deeplearning4j_tpu.observability import introspection
 
         net = self.net
@@ -347,6 +379,203 @@ class ParallelWrapper:
             jax.jit(fit_window, donate_argnums=(0, 1, 2)),
             "ParallelWrapper.fit_window", argnums=(3, 4, 5, 6, 7, 8, 9))
 
+    def _build_zero(self):
+        """The ZeRO-sharded window (update_sharding="zero",
+        averaging_frequency=1): persistent params + optimizer moments
+        live sharded 1/K per device.  Inside a ``shard_map`` each device
+        all-gathers the params, runs ITS replica's forward/backward
+        (same per-replica RNG keys and per-layer gradient normalization
+        as the vmapped replicated window), and an all-to-all hands every
+        replica's gradient shard to its owner.  Outside, under GSPMD,
+        the per-replica elementwise updates are computed against the
+        SHARED sharded moments, weighted-averaged over replicas (the
+        elastic / pad / poison ``[K]`` weight mask applies unchanged),
+        and applied to the local shard — reproducing the replicated
+        window's average-of-per-replica-updates semantics exactly.  The
+        ``__stability__`` / ``__introspect__`` subtrees stay stacked per
+        replica as in replicated mode (recorded in the ledger notes)."""
+        from deeplearning4j_tpu.backend.compat import shard_map
+        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.resilience import stability
+
+        net = self.net
+        cfg = net.conf.updater
+        cfg_sharded = zero_mod.no_norm(cfg)
+        policy = net.conf.stability
+        plan = introspection.plan_for(net)
+        lr_overrides = {
+            l.name: l.learning_rate for l in net.layers
+            if l.learning_rate is not None
+        }
+        K = self.workers
+        mesh = self.mesh
+        layout = self._zero_layout
+        pmask = layout.mask(net.params)
+        p_specs = layout.tree_specs(net.params)
+        kw = ({"collect_acts": True}
+              if plan is not None and plan.collect_acts else {})
+        AX = zero_mod.AXIS
+
+        def fit_window(p_sh, upd_k, ns_k, iteration, xs, ys, rngs, fms, lms,
+                       weights):
+            _, upd2 = introspection.split_state(upd_k)
+            if policy is not None:
+                stab_k, inner_sh = stability.split_state(upd2)
+            else:
+                stab_k, inner_sh = None, upd2
+            # F == 1 enforced at construction: one frame per window
+            x1, y1, rng1 = xs[0], ys[0], rngs[0]
+            fm1 = None if fms is None else fms[0]
+            lm1 = None if lms is None else lms[0]
+            has_fm, has_lm = fm1 is not None, lm1 is not None
+
+            def local(p_blk, ns_blk, xk, yk, rngk, *rest):
+                i = 0
+                fmk = rest[i][0] if has_fm else None
+                i += 1 if has_fm else 0
+                lmk = rest[i][0] if has_lm else None
+                i += 1 if has_lm else 0
+                scale = (jax.tree_util.tree_map(lambda a: a[0], rest[i])
+                         ["loss_scale"] if policy is not None else None)
+                p_full = zero_mod.all_gather_tree(p_blk, pmask)
+                ns_local = jax.tree_util.tree_map(lambda a: a[0], ns_blk)
+                xk0, yk0, rngk0 = xk[0], yk[0], rngk[0]
+
+                def lf(p, n):
+                    loss, aux = net._loss_fn(p, n, xk0, yk0, rngk0, fmk,
+                                             lmk, None, **kw)
+                    if policy is not None:
+                        return loss * scale, (loss, aux)
+                    return loss, (loss, aux)
+
+                (_, (loss, aux)), g = jax.value_and_grad(
+                    lf, has_aux=True)(p_full, ns_local)
+                new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
+                if policy is not None:
+                    inv = 1.0 / scale
+                    g = jax.tree_util.tree_map(lambda a: a * inv, g)
+                    finite = stability.all_finite(loss, g)
+                else:
+                    finite = jnp.ones((), jnp.bool_)
+                outs = []
+                if plan is not None:
+                    # per-replica per-layer grad norms, measured like
+                    # replicated mode: raw (unnormalized) unscaled grads
+                    outs.append(zero_mod.tree_norms(plan, g)[None])
+                # per-replica per-layer normalization on the FULL
+                # gradient (exact replicated semantics), BEFORE the
+                # scatter — the sharded updater runs with norm off
+                g = upd.normalize_tree(cfg, g)
+                g_all = zero_mod.all_to_all_tree(g, K)
+                head = [g_all, loss[None], finite[None],
+                        jax.tree_util.tree_map(lambda a: a[None], new_ns)]
+                if act_stats is not None:
+                    outs.append(jax.tree_util.tree_map(
+                        lambda a: a[None], act_stats))
+                return tuple(head + outs)
+
+            in_specs = [p_specs, P(AX), P(AX), P(AX), P(AX)]
+            args = [p_sh, ns_k, x1, y1, rng1]
+            if has_fm:
+                in_specs.append(P(AX)); args.append(fm1)
+            if has_lm:
+                in_specs.append(P(AX)); args.append(lm1)
+            if policy is not None:
+                in_specs.append(P(AX)); args.append(stab_k)
+            out_specs = [zero_mod.grad_stack_specs(net.params, K),
+                         P(AX), P(AX), P(AX)]
+            if plan is not None:
+                out_specs.append(P(AX))
+            if kw:
+                out_specs.append(P(AX))
+            out = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                            out_specs=tuple(out_specs),
+                            check_vma=False)(*args)
+            g_all, losses_k, fin_k, new_ns_k = out[0], out[1], out[2], out[3]
+            idx = 4
+            gn_k = act_k = None
+            if plan is not None:
+                gn_k = out[idx]; idx += 1
+            if kw:
+                act_k = out[idx]
+            g_all = {ln: lg for ln, lg in g_all.items() if lg}
+            fin_f = fin_k.astype(jnp.float32)
+            weights_eff = weights
+            if policy is not None:
+                # poison masking: a replica with a non-finite step is
+                # weighted out; all real replicas poisoned falls back to
+                # the original weights (each update is zeroed anyway)
+                w_eff = weights * fin_f
+                safe = jnp.sum(w_eff) > 0
+                weights_eff = jnp.where(safe, w_eff, weights)
+            wsum = jnp.sum(weights_eff)
+
+            def rk(vec, a):
+                return vec.reshape((a.shape[0],) + (1,) * (a.ndim - 1))
+
+            def wavg_k(a):          # [K, ...] -> [...] weighted mean
+                return jnp.sum(a * rk(weights_eff, a), 0) / wsum
+
+            def wavg_bcast(a):      # [K, ...] -> all K slots = the mean
+                m = jnp.sum(a * rk(weights_eff, a), 0,
+                            keepdims=True) / wsum
+                return jnp.broadcast_to(m.astype(a.dtype), a.shape)
+
+            # per-replica elementwise updates against the SHARED sharded
+            # moments — the all-to-all delivered g_all leaves as
+            # [K(replica), shard...], so this is shard-local work
+            def per_k(gk):
+                return upd.update(cfg_sharded, gk, inner_sh, iteration,
+                                  lr_overrides, params=p_sh)
+
+            updates_k, new_inner_k = jax.vmap(per_k)(g_all)
+            if policy is not None:
+                lr_scale_k = stab_k["lr_scale"]
+                if policy.skip_nonfinite:
+                    sc_k = jnp.where(fin_f > 0, lr_scale_k, 0.0)
+                    updates_k = jax.tree_util.tree_map(
+                        lambda u: jnp.where(rk(fin_f, u) > 0, u,
+                                            jnp.zeros_like(u))
+                        * rk(sc_k, u), updates_k)
+                    new_inner_k = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(rk(fin_f, n) > 0, n,
+                                               o[None].astype(n.dtype)),
+                        new_inner_k, inner_sh)
+                    new_ns_k = jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(rk(fin_f, n) > 0, n, o),
+                        new_ns_k, ns_k)
+                else:
+                    updates_k = jax.tree_util.tree_map(
+                        lambda u: u * rk(lr_scale_k, u), updates_k)
+            u_mean = jax.tree_util.tree_map(wavg_k, updates_k)
+            new_p = dict(p_sh)
+            for ln, u in u_mean.items():
+                new_p[ln] = upd.apply_updates(p_sh[ln], u)
+            new_upd: Dict[str, Any] = jax.tree_util.tree_map(wavg_k,
+                                                             new_inner_k)
+            ns_out = jax.tree_util.tree_map(wavg_bcast, new_ns_k)
+            if policy is not None:
+                new_stab_k = jax.vmap(
+                    lambda s, f: stability.next_state(policy, s, f))(
+                    stab_k, fin_k)
+                new_upd[stability.STATE_KEY] = jax.tree_util.tree_map(
+                    wavg_bcast, new_stab_k)
+            if plan is not None:
+                un = zero_mod.update_delta_norms(plan, p_sh, new_p)
+                pn = zero_mod.tree_norms(plan, p_sh)
+                new_upd[introspection.STATE_KEY] = \
+                    zero_mod.pack_introspection(plan, iteration, gn_k, un,
+                                                pn, act_k)
+            losses = losses_k[None]
+            if policy is not None:
+                return (new_p, new_upd, ns_out, losses, 1.0 - fin_f,
+                        jnp.sum(1.0 - fin_f))
+            return new_p, new_upd, ns_out, losses
+
+        self._step_fn = instrument(
+            jax.jit(fit_window, donate_argnums=(0, 1, 2)),
+            "ParallelWrapper.fit_window_zero", argnums=(3, 4, 5, 6, 7, 8, 9))
+
     # -- fit ---------------------------------------------------------------
     def fit(self, iterator):
         """Train over an iterator of DataSets.  Each averaging window
@@ -395,25 +624,24 @@ class ParallelWrapper:
             # introspection state must exist BEFORE replica stacking so
             # the per-layer stat vectors ride in upd_k as [K, L]
             introspection.ensure_state(net)
-        params_k = _stack_tree(net.params, K)
-        upd_k = _stack_tree(net.updater_state, K)
-        ns_k = _stack_tree(net.net_state, K)
         shard = self._replica_sharding()
-        params_k = jax.device_put(params_k, shard)
-        upd_k = jax.device_put(upd_k, shard) if net.updater_state else upd_k
-        ns_k = jax.device_put(ns_k, shard) if net.net_state else ns_k
-        # sharding ledger over the stacked replica view, measured against
-        # the facade's single-model trees: full replication reads K here
-        # — the baseline the ZeRO update sharding (ROADMAP item 2) will
-        # drive toward 1 for the updater-state row.  Metadata walk only;
-        # recorded once per fit, before the first (donating) dispatch.
+        params_k, upd_k, ns_k = self._stage(net, K, shard)
+        # sharding ledger over the staged trees, measured against the
+        # facade's single-model trees: full replication reads K on the
+        # stacked replica view; with update_sharding="zero" the params
+        # and updater rows read ~1 (only the tiny stacked reserved
+        # subtrees stay per replica — recorded in the notes).  Metadata
+        # walk only; recorded once per fit, before the first (donating)
+        # dispatch.
         shardstats.record_ledger(
             "parallel_wrapper",
             {"params": params_k, "updater_state": upd_k, "net_state": ns_k},
             logical_trees={"params": net.params,
                            "updater_state": net.updater_state,
                            "net_state": net.net_state},
-            data_axis_size=K)
+            data_axis_size=K,
+            notes=(self._zero_layout.notes()
+                   if self._zero_layout is not None else None))
 
         if (isinstance(iterator, ListDataSetIterator)
                 and iterator._data.features_mask is None
@@ -522,14 +750,7 @@ class ParallelWrapper:
                     if stab_rt.rewind(net, res.cm) is not None:
                         # restage the rewound facade state onto the mesh
                         it = net.iteration
-                        params_k = jax.device_put(
-                            _stack_tree(net.params, K), shard)
-                        upd_k = _stack_tree(net.updater_state, K)
-                        if net.updater_state:
-                            upd_k = jax.device_put(upd_k, shard)
-                        ns_k = _stack_tree(net.net_state, K)
-                        if net.net_state:
-                            ns_k = jax.device_put(ns_k, shard)
+                        params_k, upd_k, ns_k = self._stage(net, K, shard)
             if introspect:
                 from deeplearning4j_tpu.observability import introspection
 
@@ -594,12 +815,53 @@ class ParallelWrapper:
         combined = mask * pad_w
         return combined if combined.sum() > 0 else mask
 
+    def _stage(self, net, K, shard):
+        """Stage the facade's trees onto the mesh: stacked ``[K, ...]``
+        replicas (replicated mode) or the ZeRO layout — params + inner
+        updater slots sharded 1/K per device, the reserved subtrees and
+        net state stacked per replica as in replicated mode."""
+        if self.update_sharding == zero_mod.ZERO:
+            layout = self._zero_layout
+            params_z = layout.place(net.params)
+            upd_z = (layout.place_updater(
+                net.updater_state,
+                reserved_place=lambda t: jax.device_put(
+                    _stack_tree(t, K), shard))
+                if net.updater_state else {})
+            ns_z = _stack_tree(net.net_state, K)
+            if net.net_state:
+                ns_z = jax.device_put(ns_z, shard)
+            return params_z, upd_z, ns_z
+        params_k = jax.device_put(_stack_tree(net.params, K), shard)
+        upd_k = _stack_tree(net.updater_state, K)
+        if net.updater_state:
+            upd_k = jax.device_put(upd_k, shard)
+        ns_k = _stack_tree(net.net_state, K)
+        if net.net_state:
+            ns_k = jax.device_put(ns_k, shard)
+        return params_k, upd_k, ns_k
+
     def _fold_back(self, net, params_k, upd_k, ns_k, it, last_losses):
         """Fold the averaged replica-0 state back into the facade (loop
-        end, window-boundary checkpoint saves, preemption stop)."""
-        net.params = jax.tree_util.tree_map(lambda a: a[0], params_k)
-        net.updater_state = jax.tree_util.tree_map(lambda a: a[0], upd_k)
-        net.net_state = jax.tree_util.tree_map(lambda a: a[0], ns_k)
+        end, window-boundary checkpoint saves, preemption stop).  Under
+        ZeRO the params / inner updater leaves are already the single
+        logical copy (sharded jax arrays — the facade, the checkpoint
+        writer and ``net.output`` consume them directly); only the
+        stacked reserved subtrees and net state take the replica-0
+        slice."""
+        if self.update_sharding == zero_mod.ZERO:
+            net.params = params_k
+            net.updater_state = {
+                slot: (jax.tree_util.tree_map(lambda a: a[0], tree)
+                       if slot in shardstats.RESERVED_REPLICATED_SUBTREES
+                       else tree)
+                for slot, tree in upd_k.items()}
+            net.net_state = jax.tree_util.tree_map(lambda a: a[0], ns_k)
+        else:
+            net.params = jax.tree_util.tree_map(lambda a: a[0], params_k)
+            net.updater_state = jax.tree_util.tree_map(lambda a: a[0],
+                                                       upd_k)
+            net.net_state = jax.tree_util.tree_map(lambda a: a[0], ns_k)
         if last_losses is not None:
             net.score_value = last_losses[-1].mean()  # device scalar; lazy
         net.iteration = it

@@ -35,6 +35,7 @@ from typing import Any, Dict, Iterable, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.backend import device as backend
@@ -43,6 +44,7 @@ from deeplearning4j_tpu.observability import (
 )
 from deeplearning4j_tpu.observability import shardstats
 from deeplearning4j_tpu.optimize import updaters as upd
+from deeplearning4j_tpu.parallel import zero as zero_mod
 from deeplearning4j_tpu.parallel.elastic import ElasticConfig, ElasticController
 
 
@@ -91,11 +93,24 @@ class SyncTrainingMaster(TrainingMaster):
 
     def __init__(self, mesh: Optional[Mesh] = None, batch_size: Optional[int] = None,
                  prefetch_size: int = 2, collect_stats: bool = False,
-                 checkpoint_manager=None, retry_policy=None, elastic=False):
+                 checkpoint_manager=None, retry_policy=None, elastic=False,
+                 update_sharding: str = zero_mod.REPLICATED):
         self.mesh = mesh or backend.default_mesh()
         self.batch_size = batch_size
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
+        # ZeRO update sharding (arXiv 2004.13336, docs/PARALLELISM.md
+        # "ZeRO"): with update_sharding="zero" the gradients are
+        # reduce-scattered instead of all-reduced, each device updates
+        # only its 1/K shard of the params + updater state, and the
+        # params are all-gathered for the next forward — same wire
+        # bytes, 1/K the persistent optimizer memory.  Default
+        # "replicated" keeps today's all-reduce + replicated update.
+        self.update_sharding = zero_mod.validate_mode(update_sharding,
+                                                      self.mesh)
+        self._zero_layout = (zero_mod.ZeroLayout(self.mesh)
+                             if self.update_sharding == zero_mod.ZERO
+                             else None)
         # elasticity (docs/resilience.md "Elasticity"): a dead/hung/
         # straggling data shard is evicted by zeroing its rows in the
         # labels mask — the masked loss mean renormalizes over the healthy
@@ -288,6 +303,159 @@ class SyncTrainingMaster(TrainingMaster):
         self._params_layout = players
         self._upd_layout = ulayers
 
+    def _build_zero(self, net):
+        """The ZeRO-sharded step (update_sharding="zero"): forward +
+        backward run per data shard inside a ``shard_map`` — each device
+        all-gathers the sharded params, computes its LOCAL gradient
+        contribution (the per-shard loss weighted by that shard's share
+        of the global normalizer, so the psum of contributions is
+        exactly the replicated step's global-mean gradient, masked
+        normalization and regularization included), and reduce-scatters
+        it — then the updater, the stability guard and introspection run
+        UNCHANGED on the sharded trees under GSPMD (per-layer
+        normalization norms and finiteness reductions come out global
+        automatically).  Params and Adam moments live sharded; the
+        ``__stability__`` / ``__introspect__`` subtrees stay replicated
+        (the choice is recorded in the sharding ledger's notes)."""
+        from deeplearning4j_tpu.backend.compat import shard_map
+        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.resilience import stability
+
+        if type(self)._param_layout is not SyncTrainingMaster._param_layout:
+            raise ValueError(
+                "update_sharding='zero' composes only with the base "
+                "data-parallel param layout (replicated); "
+                f"{type(self).__name__} overrides _param_layout")
+        cfg = net.conf.updater
+        policy = net.conf.stability
+        plan = introspection.plan_for(net)
+        lr_overrides = {
+            l.name: l.learning_rate for l in net.layers
+            if l.learning_rate is not None
+        }
+        mesh = self.mesh
+        K = mesh.shape[backend.AXIS_DATA]
+        layout = self._zero_layout
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P(backend.AXIS_DATA))
+        players = layout.tree_shardings(net.params)
+        ulayers: Any = (layout.upd_shardings(net.updater_state)
+                        if net.updater_state else repl)
+        pmask = layout.mask(net.params)
+        p_specs = layout.tree_specs(net.params)
+        kw = ({"collect_acts": True}
+              if plan is not None and plan.collect_acts else {})
+        AX = zero_mod.AXIS
+
+        def step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
+            if plan is not None:
+                _, upd_state = introspection.split_state(upd_state)
+            if policy is not None:
+                stab, inner = stability.split_state(upd_state)
+                row_ok = stability.finite_rows(x, y)
+                x = stability.zero_nonfinite_rows(x, row_ok)
+                y = stability.zero_nonfinite_rows(y, row_ok)
+                lm = lm * row_ok.reshape((row_ok.shape[0],)
+                                         + (1,) * (lm.ndim - 1))
+                scale = stab["loss_scale"]
+            else:
+                stab, inner = None, upd_state
+                scale = jnp.ones((), jnp.float32)
+            has_fm = fm is not None
+
+            def local(p_blk, ns, xb, yb, rngb, lmb, sc, *rest):
+                fmb = rest[0] if has_fm else None
+                p_full = zero_mod.all_gather_tree(p_blk, pmask)
+                # this shard's share of the global normalizer: the
+                # per-shard loss is sum/max(sum(mask),1) + reg, so
+                # weighting it by sum(mask_shard)/psum(sum(mask)) makes
+                # the psum of weighted losses the exact global masked
+                # mean + reg (a fully-masked shard contributes 0, and
+                # the reg term's weights sum to 1)
+                denom = jnp.sum(lmb.astype(jnp.float32))
+                n_total = lax.psum(denom, AX)
+                w = jnp.where(n_total > 0,
+                              denom / jnp.maximum(n_total, 1.0), 0.0)
+
+                def weighted_loss(p, n):
+                    loss, aux = net._loss_fn(p, n, xb, yb, rngb, fmb, lmb,
+                                             None, **kw)
+                    return loss * (w * sc), (loss, aux)
+
+                (_, (loss_raw, aux)), g = jax.value_and_grad(
+                    weighted_loss, has_aux=True)(p_full, ns)
+                new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
+                gloss = lax.psum(loss_raw * w, AX)
+                g_sh = zero_mod.reduce_scatter_tree(g, K)
+                # per-shard batch statistics averaged into the
+                # replicated net state (batch-norm caveat:
+                # docs/PARALLELISM.md "ZeRO")
+                new_ns = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, AX), new_ns)
+                if act_stats is not None:
+                    act_stats = jax.tree_util.tree_map(
+                        lambda a: lax.pmean(a, AX), act_stats)
+                    return g_sh, gloss, new_ns, act_stats
+                return g_sh, gloss, new_ns
+
+            g_specs = jax.tree_util.tree_map(
+                lambda m: P(AX) if m else P(), pmask)
+            in_specs = (p_specs, P(), P(AX), P(AX), P(), P(AX), P()) \
+                + ((P(AX),) if has_fm else ())
+            out_specs = (g_specs, P(), P()) \
+                + ((P(),) if kw else ())
+            args = (params, net_state, x, y, rng, lm, scale) \
+                + ((fm,) if has_fm else ())
+            out = shard_map(local, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False)(*args)
+            if kw:
+                g_sh, gloss, new_ns, act_stats = out
+            else:
+                (g_sh, gloss, new_ns), act_stats = out, None
+            g_sh = {k: v for k, v in g_sh.items() if v}
+            if policy is None:
+                updates, new_us = upd.update(cfg, g_sh, inner, iteration,
+                                             lr_overrides, params=params)
+                new_params = {
+                    ln: (upd.apply_updates(params[ln], u)
+                         if (u := updates.get(ln)) else params[ln])
+                    for ln in params
+                }
+                introspection.attach(
+                    new_us, plan, grads=g_sh, params=params,
+                    new_params=new_params, iteration=iteration,
+                    act_stats=act_stats)
+                return new_params, new_us, new_ns, gloss
+            # guarded tail on the SHARDED trees: the all-poisoned-batch
+            # veto and the device-side skip mask work unchanged (the
+            # finiteness reductions over sharded leaves are global)
+            new_params, new_us, new_ns, _ = stability.apply_guarded_update(
+                policy, cfg, stab, inner, params, net_state, gloss, g_sh,
+                new_ns, iteration, lr_overrides,
+                extra_ok=jnp.sum(row_ok) > 0)
+            introspection.attach(
+                new_us, plan, grads=g_sh, params=params,
+                new_params=new_params, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
+            return (new_params, new_us, new_ns, gloss,
+                    stability.slot_poison_flags(row_ok, K))
+
+        in_shardings = (players, ulayers, repl, repl, data, data, repl,
+                        data, data)
+        out_shardings = (players, ulayers, repl, repl)
+        if policy is not None:
+            out_shardings = out_shardings + (repl,)
+        self._step = instrument(jax.jit(
+            step,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=(0, 1, 2),
+        ), f"{type(self).__name__}.step_zero", argnums=(3, 4, 5, 6, 7, 8))
+        self._data_sharding = data
+        self._repl_sharding = repl
+        self._params_layout = players
+        self._upd_layout = ulayers
+
     def execute_training(self, net, iterator):
         from deeplearning4j_tpu.datasets.iterator import AsyncDataSetIterator, DataSetIterator
         from deeplearning4j_tpu.models.common import notify_listeners
@@ -330,7 +498,10 @@ class SyncTrainingMaster(TrainingMaster):
             # under _upd_layout)
             introspection.ensure_state(net)
         if self._step is None:
-            self._build(net)
+            if self.update_sharding == zero_mod.ZERO:
+                self._build_zero(net)
+            else:
+                self._build(net)
         params = jax.device_put(net.params, self._params_layout)
         upd_state = jax.device_put(net.updater_state, self._upd_layout)
         ns = jax.device_put(net.net_state, self._repl_sharding)
@@ -345,7 +516,9 @@ class SyncTrainingMaster(TrainingMaster):
         shardstats.record_ledger(
             "sync_master",
             {"params": params, "updater_state": upd_state, "net_state": ns},
-            data_axis_size=K)
+            data_axis_size=K,
+            notes=(self._zero_layout.notes()
+                   if self._zero_layout is not None else None))
         it = iter(iterator)
         while True:
             # phases ≙ CommonSparkTrainingStats: fetch (split/repartition),
@@ -392,18 +565,21 @@ class SyncTrainingMaster(TrainingMaster):
                 y = jax.device_put(jnp.asarray(ds.labels), self._data_sharding)
                 fm = None if ds.features_mask is None else jax.device_put(
                     jnp.asarray(ds.features_mask), self._data_sharding)
-                if self._elastic is None and stab_rt is None:
+                if (self._elastic is None and stab_rt is None
+                        and self.update_sharding != zero_mod.ZERO):
                     lm_host = ds.labels_mask
                 elif emask is not None:
                     lm_host = self._evicted_labels_mask(ds, emask, K)
                 elif ds.labels_mask is not None:
                     lm_host = ds.labels_mask
                 else:
-                    # elasticity/stability keep ONE trace: the mask
+                    # elasticity/stability/ZeRO keep ONE trace: the mask
                     # argument is always an array (all-ones == the
-                    # unmasked mean), so the first eviction or poisoned
-                    # row flips values, not the pytree — no recompile at
-                    # the moment the mesh degrades
+                    # unmasked mean; the ZeRO step also reads the
+                    # per-shard mask sums as its loss weights), so the
+                    # first eviction or poisoned row flips values, not
+                    # the pytree — no recompile at the moment the mesh
+                    # degrades
                     lm_host = np.ones(
                         (len(ds),) + (1,) * (ds.labels.ndim - 2),
                         np.float32)
@@ -563,7 +739,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                  averaging_frequency: int = 5, average_updaters: bool = True,
                  prefetch_size: int = 2, repartition: str = "always",
                  mesh: Optional[Mesh] = None, collect_stats: bool = False,
-                 elastic=False):
+                 elastic=False, update_sharding: str = zero_mod.REPLICATED):
         self.mesh = mesh or backend.default_mesh()
         self.workers = workers or self.mesh.shape[backend.AXIS_DATA]
         self.batch_size = batch_size
@@ -571,6 +747,17 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         self.average_updaters = average_updaters
         self.prefetch_size = prefetch_size
         self.collect_stats = collect_stats
+        # forwarded to each per-fit ParallelWrapper; validated HERE so a
+        # bad mode (or ZeRO with a local-SGD frequency) fails at
+        # construction like the other masters, not at the first fit
+        self.update_sharding = zero_mod.validate_mode(update_sharding,
+                                                      self.mesh)
+        if (self.update_sharding == zero_mod.ZERO
+                and self.averaging_frequency != 1):
+            raise ValueError(
+                "update_sharding='zero' requires averaging_frequency=1 "
+                f"(got {self.averaging_frequency}): local-SGD windows "
+                "need full per-replica updater state between averages")
         # One persistent controller shared by every per-fit ParallelWrapper:
         # eviction state and flag budgets survive epoch boundaries instead
         # of resetting with each epoch's fresh wrapper.
@@ -601,6 +788,7 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             average_updaters=self.average_updaters,
             mesh=self.mesh,
             elastic=self._elastic if self._elastic is not None else False,
+            update_sharding=self.update_sharding,
         )
         with self._phases.phase("fit"):
             pw.fit(iterator)
@@ -653,9 +841,14 @@ class DistributedNetwork:
             pad_to = mesh.shape[backend.AXIS_DATA]
             if getattr(self, "_eval_mesh", None) is not mesh:
                 data = NamedSharding(mesh, P(backend.AXIS_DATA))
-                repl = NamedSharding(mesh, P())
+                # params/net-state shardings are taken from the ARGS
+                # (None = as-given): after a ZeRO fit the facade holds
+                # genuinely sharded params, and pinning them replicated
+                # here would reject them — GSPMD gathers what the
+                # forward needs either way
                 self._eval_fn = jax.jit(self.net._output_fn(),
-                                        in_shardings=(repl, repl, data, data))
+                                        in_shardings=(None, None, data,
+                                                      data))
                 self._eval_mesh = mesh
             sharded = self._eval_fn
 

@@ -1,0 +1,290 @@
+"""ZeRO-style cross-replica sharding of the weight update.
+
+Reference: "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (arXiv 2004.13336) — at production model sizes
+the Adam moments alone triple per-chip memory, yet every replica of a
+data-parallel run stores and applies the SAME weight update.  The paper's
+decomposition: reduce-scatter the gradients, let each replica update only
+its 1/K shard of the parameters and optimizer state, all-gather the
+parameters for the next forward.  Wire cost is identical to the
+all-reduce it replaces (ring: 2(K-1)/K · bytes, split as (K-1)/K
+reduce-scatter + (K-1)/K all-gather) and the persistent optimizer state
+drops from K copies to one.
+
+This module is the shared substrate both masters' ``update_sharding=
+"zero"`` modes build on (``SyncTrainingMaster`` / ``ParallelWrapper``):
+
+- **ZeroLayout** — the per-leaf sharding decision.  A leaf participates
+  when its leading dimension divides the data-axis size
+  (``shardstats.zero_shardable`` — the ONE owner of the predicate, so
+  the ledger's projected-ZeRO column and the actual layout can be held
+  to each other); non-dividing leaves and the reserved
+  ``__stability__`` / ``__introspect__`` updater subtrees stay
+  replicated, and the choice is recorded in the sharding ledger's
+  ``notes``.
+- **Collective helpers** used INSIDE the masters' ``shard_map`` blocks:
+  ``all_gather_tree`` (sharded params -> full, the pre-forward gather),
+  ``reduce_scatter_tree`` (summed gradient contributions -> shards; the
+  sync master's exact decomposition), and ``all_to_all_tree`` (every
+  replica's gradient shard -> the shard owner; the wrapper needs each
+  replica's OWN gradient per shard because its semantics average the
+  per-replica Adam UPDATES, which are nonlinear in the gradients — an
+  all-to-all moves exactly the reduce-scatter's (K-1)/K byte count, so
+  the wire win is identical).
+- **Spec builders** for the ``shard_map`` in/out spec trees and the
+  jit in/out shardings.
+- ``pack_introspection`` — the ``__introspect__`` packing for the
+  wrapper's ZeRO window (per-replica gradient norms survive; update and
+  param norms are computed once from the sharded trees, since the
+  update is shared across replicas under ZeRO).
+
+Semantics contract (tests/test_zero.py): a ZeRO run matches the same
+master's replicated mode within rtol 1e-5 per step on params — including
+Adam, the stability guard's non-finite skip/poison masking, and elastic
+eviction — with zero steady-state recompiles.  Known trace differences,
+documented in docs/PARALLELISM.md: dropout draws per data shard instead
+of per global batch in the sync master (same key, different shape), and
+batch-norm batch statistics are per-shard (averaged into the replicated
+net state), mirroring the wrapper's existing per-replica semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.backend import device as backend
+from deeplearning4j_tpu.observability.shardstats import (
+    RESERVED_REPLICATED_SUBTREES, zero_shardable,
+)
+
+REPLICATED = "replicated"
+ZERO = "zero"
+MODES = (REPLICATED, ZERO)
+
+AXIS = backend.AXIS_DATA
+
+
+def validate_mode(update_sharding: str, mesh) -> str:
+    """Validate an ``update_sharding=`` constructor argument against the
+    mesh.  ZeRO requires a pure data-parallel mesh: the reduce-scatter /
+    all-gather pair is laid over the 'data' axis, and a live model/seq
+    axis would need a 2-D sharding composition this mode does not
+    implement (compose via the pipeline/TP masters instead)."""
+    if update_sharding not in MODES:
+        raise ValueError(
+            f"update_sharding must be one of {MODES}, "
+            f"got {update_sharding!r}")
+    if update_sharding == ZERO:
+        sizes = dict(mesh.shape)
+        extra = 1
+        for ax in (backend.AXIS_MODEL, backend.AXIS_SEQ):
+            extra *= int(sizes.get(ax, 1))
+        if extra != 1:
+            raise ValueError(
+                "update_sharding='zero' needs a pure data-parallel mesh "
+                f"(model*seq axes must be 1, got {extra})")
+        if mesh.shape[AXIS] < 2:
+            raise ValueError(
+                "update_sharding='zero' needs a data axis of at least 2 "
+                f"devices (got {mesh.shape[AXIS]}) — on one device there "
+                "is nothing to shard")
+    return update_sharding
+
+
+def no_norm(cfg):
+    """A copy of an ``UpdaterConfig`` with gradient normalization
+    disabled — the ZeRO paths normalize per replica on the FULL gradient
+    (exactly like replicated mode, where the per-layer norms span the
+    whole layer) before the gradients are scattered, so the sharded
+    elementwise updater must not re-normalize on shard-local norms."""
+    if cfg.gradient_normalization == "none":
+        return cfg
+    return dataclasses.replace(cfg, gradient_normalization="none")
+
+
+class ZeroLayout:
+    """Per-leaf ZeRO sharding decisions over the mesh's data axis."""
+
+    def __init__(self, mesh, k: Optional[int] = None):
+        self.mesh = mesh
+        self.k = int(k if k is not None else mesh.shape[AXIS])
+        self._repl = NamedSharding(mesh, P())
+
+    # ------------------------------------------------------------ per leaf
+    def shardable(self, leaf) -> bool:
+        return zero_shardable(getattr(leaf, "shape", ()), self.k)
+
+    def spec(self, leaf) -> P:
+        return P(AXIS) if self.shardable(leaf) else P()
+
+    def sharding(self, leaf) -> NamedSharding:
+        return (NamedSharding(self.mesh, P(AXIS)) if self.shardable(leaf)
+                else self._repl)
+
+    # ------------------------------------------------------------ per tree
+    def mask(self, tree):
+        """Pytree of booleans: which leaves shard.  Computed from GLOBAL
+        shapes, so it can be closed over by ``shard_map`` bodies whose
+        blocks carry divided shapes."""
+        return jax.tree_util.tree_map(self.shardable, tree)
+
+    def tree_specs(self, tree):
+        return jax.tree_util.tree_map(self.spec, tree)
+
+    def tree_shardings(self, tree):
+        return jax.tree_util.tree_map(self.sharding, tree)
+
+    def place(self, tree):
+        """Device-put a host/replicated tree into the ZeRO layout."""
+        return jax.device_put(tree, self.tree_shardings(tree))
+
+    def upd_shardings(self, upd_state, reserved_sharding=None):
+        """Shardings for an updater-state tree: inner optimizer slots
+        (Adam moments & co) take the per-leaf ZeRO layout; the reserved
+        ``__stability__`` / ``__introspect__`` subtrees take
+        ``reserved_sharding`` (default: replicated — the sync master's
+        choice; the wrapper passes its stacked-per-replica sharding)."""
+        reserved = (reserved_sharding if reserved_sharding is not None
+                    else self._repl)
+        return {
+            slot: (jax.tree_util.tree_map(lambda _l: reserved, tree)
+                   if slot in RESERVED_REPLICATED_SUBTREES
+                   else self.tree_shardings(tree))
+            for slot, tree in upd_state.items()
+        }
+
+    def place_updater(self, upd_state, reserved_place=None):
+        """Device-put an updater-state tree into the ZeRO layout;
+        ``reserved_place(subtree)`` overrides placement of the reserved
+        subtrees (the wrapper stacks them per replica)."""
+        out = {}
+        for slot, tree in upd_state.items():
+            if slot in RESERVED_REPLICATED_SUBTREES:
+                out[slot] = (reserved_place(tree) if reserved_place
+                             else jax.device_put(tree, self._repl))
+            else:
+                out[slot] = self.place(tree)
+        return out
+
+    def notes(self) -> Dict[str, Any]:
+        """The ledger provenance record for this layout."""
+        return {"update_sharding": ZERO,
+                "data_axis_size": self.k,
+                "reserved_subtrees": {
+                    k: "replicated" for k in RESERVED_REPLICATED_SUBTREES}}
+
+
+# ---------------------------------------------------------------------------
+# collective helpers — call these INSIDE a shard_map body over the data axis
+# ---------------------------------------------------------------------------
+
+def all_gather_tree(blocks, mask):
+    """Sharded param blocks -> full leaves (the pre-forward gather).
+    ``mask`` is ``ZeroLayout.mask`` of the GLOBAL tree; non-sharded
+    leaves pass through untouched."""
+    return jax.tree_util.tree_map(
+        lambda m, b: lax.all_gather(b, AXIS, axis=0, tiled=True) if m else b,
+        mask, blocks)
+
+
+def reduce_scatter_tree(full, k: int):
+    """Per-device gradient contributions -> summed shards.  Shardable
+    leaves take a genuine reduce-scatter (each device receives the sum
+    of its 1/K slice); non-dividing leaves fall back to a (small)
+    all-reduce and stay replicated — the same split the layout applies
+    to the state they update."""
+    def rs(leaf):
+        if zero_shardable(leaf.shape, k):
+            return lax.psum_scatter(leaf, AXIS, scatter_dimension=0,
+                                    tiled=True)
+        return lax.psum(leaf, AXIS)
+
+    return jax.tree_util.tree_map(rs, full)
+
+
+def all_to_all_tree(full, k: int):
+    """One replica's full gradient -> every replica's shard, stacked.
+    Shardable leaves of shape ``[d0, ...]`` come back as ``[K, d0/K,
+    ...]`` blocks (globally ``[K, d0, ...]`` sharded on dim 1): the
+    leading axis indexes the REPLICA, the rest is this device's shard of
+    that replica's gradient.  Non-dividing leaves all-gather to ``[K,
+    d0, ...]`` replicated.  This is the wrapper's collective: its
+    averaging semantics need each replica's own gradient at the shard
+    owner (the per-replica Adam updates it averages are nonlinear in the
+    gradients), and the all-to-all moves exactly the reduce-scatter's
+    (K-1)/K bytes per device."""
+    def a2a(leaf):
+        if zero_shardable(leaf.shape, k):
+            pieces = leaf.reshape((k, leaf.shape[0] // k) + leaf.shape[1:])
+            return lax.all_to_all(pieces, AXIS, split_axis=0, concat_axis=0,
+                                  tiled=False)
+        return lax.all_gather(leaf, AXIS, axis=0, tiled=False)
+
+    return jax.tree_util.tree_map(a2a, full)
+
+
+def grad_stack_specs(tree, k: int):
+    """``shard_map`` out_specs for an ``all_to_all_tree`` result: the
+    replica axis is unsharded, the shard axis is dim 1."""
+    return jax.tree_util.tree_map(
+        lambda leaf: (P(None, AXIS) if zero_shardable(leaf.shape, k)
+                      else P()),
+        tree)
+
+
+# ---------------------------------------------------------------------------
+# introspection packing for the wrapper's ZeRO window
+# ---------------------------------------------------------------------------
+
+def pack_introspection(plan, iteration, grad_norms_k, update_norm,
+                       param_norm, act_stats_k=None):
+    """Build the stacked ``[K, N]`` ``__introspect__`` state for a ZeRO
+    wrapper window: per-replica gradient norms (``[K, L]``, measured on
+    each replica's own unscaled gradient before the scatter), shared
+    update/param norms (``[L]``, broadcast — under ZeRO every replica
+    applies the same averaged update), and per-replica activation stats
+    (``[K, A]``) when the plan collects them.  Field order matches
+    ``introspection.collect``."""
+    K = grad_norms_k.shape[0]
+    it = jnp.broadcast_to(
+        jnp.asarray(iteration, jnp.float32).reshape(1, 1), (K, 1))
+    un = jnp.broadcast_to(update_norm[None, :], grad_norms_k.shape)
+    pn = jnp.broadcast_to(param_norm[None, :], grad_norms_k.shape)
+    parts = [it, grad_norms_k, un, pn]
+    if plan.act_names:
+        if act_stats_k is None:
+            raise ValueError(
+                "plan collects activations but no act_stats were passed")
+        parts += [act_stats_k["act_mean"], act_stats_k["act_std"],
+                  act_stats_k["act_zero"]]
+    return {"packed": jnp.concatenate(parts, axis=1)}
+
+
+def tree_norms(plan, tree):
+    """Per-layer L2 norms ``[L]`` of a (possibly sharded) tree in
+    ``plan.grad_names`` order — under GSPMD the reductions over sharded
+    leaves are global, so the values equal the replicated-mode norms."""
+    from deeplearning4j_tpu.observability.introspection import _sq_sum
+
+    return jnp.stack([
+        jnp.sqrt(_sq_sum(tree.get(name, {}) if hasattr(tree, "get")
+                         else tree[name]))
+        for name in plan.grad_names])
+
+
+def update_delta_norms(plan, old_params, new_params):
+    """Per-layer L2 norms of ``old - new`` (the applied update) over
+    sharded trees — global values via GSPMD."""
+    from deeplearning4j_tpu.observability.introspection import _sq_sum
+
+    return jnp.stack([
+        jnp.sqrt(_sq_sum(jax.tree_util.tree_map(
+            lambda o, n: o.astype(jnp.float32) - n.astype(jnp.float32),
+            old_params[name], new_params[name])))
+        for name in plan.grad_names])

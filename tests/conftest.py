@@ -84,6 +84,12 @@ def pytest_configure(config):
         "(python -m pytest -m introspect)")
     config.addinivalue_line(
         "markers",
+        "zero: ZeRO update-sharding tests — reduce-scatter/all-gather "
+        "decomposition of the weight update, sharded updater state, "
+        "replicated-vs-ZeRO oracles, projection-vs-actual ledger, "
+        "checkpoint interop (python -m pytest -m zero)")
+    config.addinivalue_line(
+        "markers",
         "generation: continuous-batching generation-engine tests — "
         "paged KV cache with prefix sharing, iteration-level join/leave "
         "scheduling, zero-recompile decode, hot-swap under decode load, "
