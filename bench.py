@@ -1671,6 +1671,110 @@ def bench_introspection(platform, peak):
     }
 
 
+def bench_numerics(platform, peak):
+    """The precision ledger's contract on record (docs/observability.md
+    "Numerics"): ledger-on vs ledger-off end-to-end fit-step time on the
+    bench transformer with a StatsListener at reporting_frequency=10 —
+    the per-layer dynamic-range reductions (max-abs, exponent histogram,
+    per-format under/overflow fractions) ride inside the XLA step and
+    the harvest is one batched transfer per 10th step, so the overhead
+    must stay <5% with EXACTLY zero steady-state recompiles
+    (regression.py pins both)."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+    from deeplearning4j_tpu.nn.conf import TrainingNumerics
+    from deeplearning4j_tpu.observability import get_registry
+    from deeplearning4j_tpu.ui import (
+        InMemoryStatsStorage, StatsListener, StatsUpdateConfiguration,
+    )
+
+    if platform == "tpu":
+        batch, seq, d_model, heads, layers = 8, 2048, 1024, 8, 8
+    else:
+        # LARGER than the introspection proxy on purpose: the ledger's
+        # cost is per-layer (fixed sample budget), the step's per-FLOP —
+        # a d64 toy model puts ~1.5ms of fixed collection against an
+        # ~12ms step and misstates the production overhead the sentinel
+        # guards.  d128 L2 amortizes like a real model while still
+        # benching in seconds on CPU.
+        batch, seq, d_model, heads, layers = 2, 256, 128, 2, 2
+    vocab = 128
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
+    warmup, iters, reps = (3, 30, 3) if platform == "tpu" else (3, 10, 5)
+
+    def make_one(num):
+        net = transformer_char_lm(
+            vocab_size=vocab, d_model=d_model, n_heads=heads, layers=layers,
+            compute_dtype="bfloat16" if platform == "tpu" else None,
+            numerics=num)
+        if num is not None:
+            net.set_listeners(StatsListener(
+                InMemoryStatsStorage(),
+                config=StatsUpdateConfiguration(
+                    reporting_frequency=10, collect_memory=False,
+                    collect_histograms_params=False,
+                    collect_mean_magnitudes=False,
+                    collect_introspection=False)))
+
+        def one():
+            # the full fit path: step dispatch + listener notification
+            # (incl. the every-10th-step ledger harvest)
+            net.fit(x, y)
+            return net._score
+
+        return one
+
+    off_one = make_one(None)
+    on_one = make_one(TrainingNumerics())
+
+    def timed_loop(one):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = one()
+        _sync(out)
+        return (time.perf_counter() - t0) / iters
+
+    for _ in range(warmup):   # compile + warm BOTH arms before timing
+        off_one()
+        on_one()
+    # the zero-recompile contract: everything after warmup reuses the
+    # warmed programs — any compile here is a bench failure, not noise
+    compiles_warm = get_registry().family_total("dl4j_compiles_total")
+    # interleave the arms per rep: slow-container drift (the dominant
+    # CPU noise) hits both sides of the overhead ratio
+    t_off, t_on = [], []
+    for _ in range(reps):
+        t_off.append(timed_loop(off_one))
+        t_on.append(timed_loop(on_one))
+    steady_compiles = (get_registry().family_total("dl4j_compiles_total")
+                       - compiles_warm)
+    off_s = float(np.median(t_off))
+    on_s = float(np.median(t_on))
+    overhead = on_s / off_s - 1.0
+    return {
+        "metric": (f"Numerics-ledger train step (transformer d{d_model} "
+                   f"L{layers} T{seq}, range stats in-graph, "
+                   f"report every 10)"),
+        "value": round(on_s * 1e3, 3),
+        "unit": "ms/step",
+        "vs_baseline": None,   # no reference analog (ledger is new)
+        "data": "synthetic",
+        "dtype": "bfloat16" if platform == "tpu" else "float32",
+        "ledger_off_ms": round(off_s * 1e3, 3),
+        "overhead_frac": round(overhead, 4),
+        "ledger_overhead_ok": int(overhead < 0.05),
+        "steady_state_compiles": steady_compiles,
+        "spread": {"reps": reps,
+                   "on_rep_ms": [round(t * 1e3, 3) for t in t_on],
+                   "off_rep_ms": [round(t * 1e3, 3) for t in t_off]},
+    }
+
+
 def _performance_attribution(metrics, dev):
     """The observability.performance section: step FLOPs, MFU (spec-sheet
     peak on TPU, documented CPU estimate otherwise — always labeled), and
@@ -1733,7 +1837,8 @@ def main():
             ("zero", lambda: bench_zero(platform, peak)),
             ("online", lambda: bench_online(platform, peak)),
             ("stability", lambda: bench_stability(platform, peak)),
-            ("introspection", lambda: bench_introspection(platform, peak))):
+            ("introspection", lambda: bench_introspection(platform, peak)),
+            ("numerics", lambda: bench_numerics(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

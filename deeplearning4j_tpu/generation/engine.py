@@ -384,6 +384,24 @@ class GenerationEngine:
             ttft_ms=(round(req.ttft_s * 1e3, 3)
                      if req.ttft_s is not None else None))
 
+    def kv_numerics(self, allocated_only: bool = True) -> dict:
+        """Per-page dynamic-range ledger over the live KV pools
+        (``observability.numerics.kv_page_ledger``): the int8-KV
+        quantization-readiness evidence, read from whatever pools the
+        decode thread last published.  Pools are replaced (not mutated
+        in place) by prefill/decode, so reading the reference from
+        another thread is safe — at worst one step stale."""
+        from deeplearning4j_tpu.observability import numerics
+        pools = self._pools
+        if pools is None:
+            return {}
+        allocated = None
+        if allocated_only:
+            allocated = [p for p in range(1, self.cache.num_pages)
+                         if self.cache.refcount(p) > 0]
+        return numerics.kv_page_ledger(
+            pools, self.cache.page_size, allocated=allocated)
+
     # ----------------------------------------------------------------- stats
     def stats(self) -> dict:
         return {

@@ -29,7 +29,7 @@ from deeplearning4j_tpu.observability import (
 )
 from deeplearning4j_tpu.nn import losses as losses_mod
 from deeplearning4j_tpu.nn.conf import (
-    TrainingIntrospection, TrainingStability, UpdaterConfig,
+    TrainingIntrospection, TrainingNumerics, TrainingStability, UpdaterConfig,
 )
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
@@ -84,6 +84,8 @@ class GraphConfiguration:
     stability: Optional[Any] = None
     # training-introspection engine (nn.conf.TrainingIntrospection)
     introspection: Optional[Any] = None
+    # precision-ledger engine (nn.conf.TrainingNumerics)
+    numerics: Optional[Any] = None
 
     def topological_order(self) -> List[str]:
         """Kahn's algorithm over the DAG (reference
@@ -154,6 +156,8 @@ class GraphConfiguration:
                               if self.stability else None),
                 "introspection": (self.introspection.to_dict()
                                   if self.introspection else None),
+                "numerics": (self.numerics.to_dict()
+                             if self.numerics else None),
             },
             indent=2,
         )
@@ -178,6 +182,8 @@ class GraphConfiguration:
                        if d.get("stability") else None),
             introspection=(TrainingIntrospection.from_dict(d["introspection"])
                            if d.get("introspection") else None),
+            numerics=(TrainingNumerics.from_dict(d["numerics"])
+                      if d.get("numerics") else None),
         )
 
 
@@ -251,6 +257,7 @@ class GraphBuilder:
             tbptt_back_length=self._tbptt_back,
             stability=p._stability,
             introspection=p._introspection,
+            numerics=p._numerics,
         )
         conf.validate()
         # shape inference pass: complete layers with n_in from input types
@@ -351,6 +358,11 @@ class ComputationGraph(LazyScoreMixin):
 
             # per-layer stat vectors ride in the updater-state pytree too
             introspection.ensure_state(self)
+        if self.conf.numerics is not None:
+            from deeplearning4j_tpu.observability import numerics
+
+            # precision ledger: same reserved-subtree transport
+            numerics.ensure_state(self)
         return self
 
     def num_params(self) -> int:
@@ -438,7 +450,8 @@ class ComputationGraph(LazyScoreMixin):
         return acts, new_state, new_carries
 
     def _loss_fn(self, params, net_state, inputs, labels, rng, fmask=None,
-                 lmask=None, carries=None, train=True, collect_acts=False):
+                 lmask=None, carries=None, train=True, collect_acts=False,
+                 numerics_now=None):
         """inputs: dict name->array (or single array for 1-input graphs);
         labels: dict output-name->array or single array."""
         inputs = self._as_input_dict(inputs)
@@ -462,13 +475,22 @@ class ComputationGraph(LazyScoreMixin):
         if collect_acts:
             # introspection: per-layer-node activation summaries reduced
             # in-graph (same node order as IntrospectPlan.act_names)
-            from deeplearning4j_tpu.observability import introspection
-
+            named = [(n.name, acts[n.name]) for n in self.conf.nodes
+                     if n.layer is not None]
             policy = self.conf.introspection
-            act_stats = introspection.act_summary(
-                [(n.name, acts[n.name]) for n in self.conf.nodes
-                 if n.layer is not None],
-                dead_eps=policy.dead_eps if policy is not None else 0.0)
+            act_stats = {}
+            if policy is not None:
+                from deeplearning4j_tpu.observability import introspection
+
+                act_stats = introspection.act_summary(
+                    named, dead_eps=policy.dead_eps)
+            npolicy = self.conf.numerics
+            if npolicy is not None and npolicy.collect_activations:
+                # precision ledger: activation dynamic-range blocks
+                from deeplearning4j_tpu.observability import numerics
+
+                act_stats.update(numerics.act_ranges(
+                    named, policy=npolicy, now=numerics_now))
             return total, (new_state, new_carries, act_stats)
         return total, (new_state, new_carries)
 
@@ -491,7 +513,7 @@ class ComputationGraph(LazyScoreMixin):
         """The raw (un-jitted) SGD step shared by the per-batch train step
         and the scanned multi-step window (mirrors
         ``MultiLayerNetwork._step_core``)."""
-        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.observability import introspection, numerics
         from deeplearning4j_tpu.optimize import updaters as upd
 
         cfg = self.conf.updater
@@ -503,20 +525,27 @@ class ComputationGraph(LazyScoreMixin):
 
         policy = self.conf.stability
         plan = introspection.plan_for(self)
+        nplan = numerics.plan_for(self)
 
         def step(params, upd_state, net_state, iteration, inputs, labels,
                  rng, fmask, lmask, carries):
+            nstate = None
+            if nplan is not None:
+                nstate, upd_state = numerics.split_state(upd_state)
             if plan is not None:
                 _, upd_state = introspection.split_state(upd_state)
+            now = numerics.collect_now(nplan, iteration)
             kw = ({"collect_acts": True}
-                  if plan is not None and plan.collect_acts else {})
+                  if numerics.wants_acts(plan, nplan) else {})
+            if kw and now is not None:
+                kw["numerics_now"] = now
             if policy is None:
                 (loss, aux), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True
                 )(params, net_state, inputs, labels, rng, fmask, lmask,
                   carries, **kw)
                 new_ns, new_carries, act_stats = (
-                    introspection.unpack_aux(plan, aux))
+                    numerics.unpack_aux(plan, nplan, aux))
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration,
                                              lr_overrides, params=params)
@@ -527,6 +556,9 @@ class ComputationGraph(LazyScoreMixin):
                     new_us, plan, grads=grads, params=params,
                     new_params=new_params, iteration=iteration,
                     act_stats=act_stats)
+                numerics.attach(
+                    new_us, nplan, grads=grads, iteration=iteration,
+                    act_stats=act_stats, prev=nstate, now=now)
                 return new_params, new_us, new_ns, loss, new_carries
             # non-finite step guard + loss scaling: a poisoned step folds
             # into a device-side no-op (resilience/stability.py; same
@@ -539,7 +571,7 @@ class ComputationGraph(LazyScoreMixin):
             )(params, net_state, inputs, labels, rng, fmask, lmask,
               carries, **kw)
             new_ns, new_carries, act_stats = (
-                introspection.unpack_aux(plan, aux))
+                numerics.unpack_aux(plan, nplan, aux))
             new_params, new_us, new_ns, finite = (
                 stability.apply_guarded_update(
                     policy, cfg, stab, inner, params, net_state,
@@ -548,6 +580,10 @@ class ComputationGraph(LazyScoreMixin):
                 new_us, plan, grads=grads, params=params,
                 new_params=new_params, iteration=iteration,
                 act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
+            numerics.attach(
+                new_us, nplan, grads=grads, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"],
+                prev=nstate, now=now)
             if new_carries is not None and policy.skip_nonfinite:
                 # poisoned TBPTT window: reset the recurrent stream state
                 # rather than carrying NaN into the next window
@@ -610,6 +646,11 @@ class ComputationGraph(LazyScoreMixin):
 
             introspection.ensure_state(self)
             self._introspect_live = None
+        if self.conf.numerics is not None:
+            from deeplearning4j_tpu.observability import numerics
+
+            numerics.ensure_state(self)
+            self._numerics_live = None
         scanned = self._jit_cache.setdefault(
             "scanned_step", self._make_scanned_step())
         for _ in range(epochs):
@@ -718,6 +759,11 @@ class ComputationGraph(LazyScoreMixin):
             introspection.ensure_state(self)
             # facade updater_state is authoritative during a solo fit
             self._introspect_live = None
+        if self.conf.numerics is not None:
+            from deeplearning4j_tpu.observability import numerics
+
+            numerics.ensure_state(self)
+            self._numerics_live = None
         from deeplearning4j_tpu.resilience import preemption_requested
 
         try:

@@ -87,6 +87,11 @@ class MultiLayerNetwork(LazyScoreMixin):
             # too: stacked per replica, replicated by the sync master,
             # donated, checkpointed (docs/observability.md)
             introspection.ensure_state(self)
+        if self.conf.numerics is not None:
+            from deeplearning4j_tpu.observability import numerics
+
+            # precision ledger: same reserved-subtree transport
+            numerics.ensure_state(self)
         return self
 
     def _trainable(self, params):
@@ -183,7 +188,8 @@ class MultiLayerNetwork(LazyScoreMixin):
 
     # ----------------------------------------------------------------- score
     def _loss_fn(self, params, net_state, x, y, rng, fmask=None, lmask=None,
-                 carries=None, train=True, collect_acts=False):
+                 carries=None, train=True, collect_acts=False,
+                 numerics_now=None):
         out_layer = self.layers[-1]
         if not isinstance(out_layer, OutputLayer):
             raise ValueError("Last layer must be an OutputLayer/RnnOutputLayer for fit()")
@@ -202,12 +208,22 @@ class MultiLayerNetwork(LazyScoreMixin):
             # introspection: summarize every layer's activations while
             # they are still live in the graph (reduced to [A] scalars
             # immediately — the full activations are never carried out)
-            from deeplearning4j_tpu.observability import introspection
-
+            named = list(zip((l.name for l in self.layers), acts))
             policy = self.conf.introspection
-            act_stats = introspection.act_summary(
-                list(zip((l.name for l in self.layers), acts)),
-                dead_eps=policy.dead_eps if policy is not None else 0.0)
+            act_stats = {}
+            if policy is not None:
+                from deeplearning4j_tpu.observability import introspection
+
+                act_stats = introspection.act_summary(
+                    named, dead_eps=policy.dead_eps)
+            npolicy = self.conf.numerics
+            if npolicy is not None and npolicy.collect_activations:
+                # precision ledger: activation dynamic-range blocks,
+                # reduced in-graph the same way
+                from deeplearning4j_tpu.observability import numerics
+
+                act_stats.update(numerics.act_ranges(
+                    named, policy=npolicy, now=numerics_now))
             return data_loss + reg, (new_state, new_carries, act_stats)
         return data_loss + reg, (new_state, new_carries)
 
@@ -222,26 +238,33 @@ class MultiLayerNetwork(LazyScoreMixin):
         and net state likewise) — zero host syncs, zero recompiles
         (resilience/stability.py).  ``stability=None`` keeps the exact
         pre-guard trace."""
-        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.observability import introspection, numerics
 
         updater_cfg = self.conf.updater
         policy = self.conf.stability
         plan = introspection.plan_for(self)
+        nplan = numerics.plan_for(self)
         lr_overrides = {
             l.name: l.learning_rate for l in self.layers if l.learning_rate is not None
         }
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fmask, lmask, carries):
+            nstate = None
+            if nplan is not None:
+                nstate, upd_state = numerics.split_state(upd_state)
             if plan is not None:
                 _, upd_state = introspection.split_state(upd_state)
+            now = numerics.collect_now(nplan, iteration)
             kw = ({"collect_acts": True}
-                  if plan is not None and plan.collect_acts else {})
+                  if numerics.wants_acts(plan, nplan) else {})
+            if kw and now is not None:
+                kw["numerics_now"] = now
             if policy is None:
                 (loss, aux), grads = jax.value_and_grad(
                     self._loss_fn, has_aux=True
                 )(params, net_state, x, y, rng, fmask, lmask, carries, **kw)
                 new_net_state, new_carries, act_stats = (
-                    introspection.unpack_aux(plan, aux))
+                    numerics.unpack_aux(plan, nplan, aux))
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_upd_state = upd.update(
                     updater_cfg, grads, upd_state, iteration, lr_overrides,
@@ -254,6 +277,9 @@ class MultiLayerNetwork(LazyScoreMixin):
                     new_upd_state, plan, grads=grads, params=params,
                     new_params=new_params, iteration=iteration,
                     act_stats=act_stats)
+                numerics.attach(
+                    new_upd_state, nplan, grads=grads, iteration=iteration,
+                    act_stats=act_stats, prev=nstate, now=now)
                 return new_params, new_upd_state, new_net_state, loss, new_carries
             from deeplearning4j_tpu.resilience import stability
 
@@ -263,7 +289,7 @@ class MultiLayerNetwork(LazyScoreMixin):
                     stability.scaled_loss(self._loss_fn, stab), has_aux=True
                 )(params, net_state, x, y, rng, fmask, lmask, carries, **kw))
             new_net_state, new_carries, act_stats = (
-                introspection.unpack_aux(plan, aux))
+                numerics.unpack_aux(plan, nplan, aux))
             new_params, new_upd_state, new_net_state, finite = (
                 stability.apply_guarded_update(
                     policy, updater_cfg, stab, inner, params, net_state,
@@ -273,6 +299,10 @@ class MultiLayerNetwork(LazyScoreMixin):
                 new_upd_state, plan, grads=grads, params=params,
                 new_params=new_params, iteration=iteration,
                 act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
+            numerics.attach(
+                new_upd_state, nplan, grads=grads, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"],
+                prev=nstate, now=now)
             if new_carries is not None and policy.skip_nonfinite:
                 # a poisoned TBPTT window must not smuggle NaN hidden
                 # state into the next window: reset the stream instead
@@ -340,6 +370,11 @@ class MultiLayerNetwork(LazyScoreMixin):
 
             introspection.ensure_state(self)
             self._introspect_live = None
+        if self.conf.numerics is not None:
+            from deeplearning4j_tpu.observability import numerics
+
+            numerics.ensure_state(self)
+            self._numerics_live = None
         scanned = self._jit_cache.setdefault(
             "scanned_step", self._make_scanned_step())
         step = self._get_train_step()
@@ -448,6 +483,11 @@ class MultiLayerNetwork(LazyScoreMixin):
             # fit; a stale per-replica stamp from an earlier master run
             # must not shadow it
             self._introspect_live = None
+        if self.conf.numerics is not None:
+            from deeplearning4j_tpu.observability import numerics
+
+            numerics.ensure_state(self)
+            self._numerics_live = None
         try:
             if labels is not None:
                 batches = [(data, labels, fmask, lmask)]

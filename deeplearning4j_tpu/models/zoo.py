@@ -342,7 +342,8 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
                         window: Optional[int] = None,
                         max_cache: int = 1024,
                         stability=None,
-                        introspection=None) -> MultiLayerNetwork:
+                        introspection=None,
+                        numerics=None) -> MultiLayerNetwork:
     """Causal transformer char-LM — the long-context flagship (no reference
     analog: the reference is pre-transformer, SURVEY.md §5).  With
     ``seq_axis='seq'`` every attention layer runs ring attention over the
@@ -375,6 +376,10 @@ def transformer_char_lm(vocab_size: int = 77, d_model: int = 128,
         # training-introspection engine (nn.conf.TrainingIntrospection):
         # per-layer gradient/update/activation stats inside the step
         nb.training_introspection(introspection)
+    if numerics is not None:
+        # precision-ledger engine (nn.conf.TrainingNumerics): per-layer
+        # dynamic-range / format-safety stats inside the step
+        nb.training_numerics(numerics)
     b = nb.list()
     if compute_dtype:
         b.compute_dtype(compute_dtype)

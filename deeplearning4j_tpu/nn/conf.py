@@ -174,6 +174,62 @@ class TrainingIntrospection:
 
 
 @dataclasses.dataclass(frozen=True)
+class TrainingNumerics:
+    """Precision-ledger policy (engine: ``observability/numerics.py``).
+
+    Per-layer dynamic-range statistics — max-abs, exponent histogram,
+    and the fraction of values that would underflow/overflow each
+    candidate narrow format (bf16 / fp16 / fp8-e4m3 / int8 with a
+    per-page scale) — for gradients, updater moments, and activations,
+    computed inside the jitted train step (the ``__introspect__``
+    pattern: one fused reduction pass per leaf, carried in a reserved
+    ``__numerics__`` updater-state subtree, zero recompiles, one
+    device->host transfer per reporting interval).  Harvested into the
+    per-layer format-safety verdicts that gate the bf16/fp8 flip
+    (ROADMAP item 3).
+
+    ``collect_activations``: also measure every layer's training
+    activations (the forward-pass half of the narrowing evidence).
+    ``absorb_threshold``: a format verdict goes risky when more than
+    this fraction of a tensor's nonzero values would underflow to zero
+    or be absorbed below the format's mantissa at the tensor's own
+    scale (or when ANY value overflows — that has no threshold).
+    ``sample``: per-(component, layer) stride-sample budget for the
+    fraction/histogram pass (max-abs is always an exact full pass, so
+    the hard overflow flag and the absorption cutoff never depend on
+    it); 0 = exact full-pass fractions.
+    ``interval``: collect the ledger every N steps (``lax.cond`` gated
+    in-graph — off-steps carry the previous snapshot through at the
+    cost of one branch, on-steps pay the stats pass; both branches
+    compile once).  The ledger is a snapshot read once per reporting
+    window, so align this with the listener's reporting frequency;
+    1 = collect every step.  The defaults keep the ledger under the
+    bench's 5% step-overhead sentinel.
+    """
+
+    collect_activations: bool = True
+    absorb_threshold: float = 0.5
+    sample: int = 1024
+    interval: int = 10
+
+    def __post_init__(self):
+        if not 0.0 < self.absorb_threshold <= 1.0:
+            raise ValueError("absorb_threshold must be in (0, 1], got "
+                             f"{self.absorb_threshold}")
+        if self.sample < 0:
+            raise ValueError(f"sample must be >= 0, got {self.sample}")
+        if self.interval < 1:
+            raise ValueError(f"interval must be >= 1, got {self.interval}")
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return TrainingNumerics(**d)
+
+
+@dataclasses.dataclass(frozen=True)
 class MultiLayerConfiguration:
     """Completed, immutable network config (reference
     ``nn/conf/MultiLayerConfiguration.java``)."""
@@ -200,6 +256,9 @@ class MultiLayerConfiguration:
     # training-introspection engine (device-side per-layer gradient/
     # update/activation statistics) — None keeps the exact prior trace
     introspection: Optional[TrainingIntrospection] = None
+    # precision-ledger engine (device-side per-layer dynamic-range /
+    # format-safety statistics) — None keeps the exact prior trace
+    numerics: Optional[TrainingNumerics] = None
 
     def __post_init__(self):
         # guard every construction path (builder, from_dict, direct): an
@@ -229,6 +288,7 @@ class MultiLayerConfiguration:
             "stability": self.stability.to_dict() if self.stability else None,
             "introspection": (self.introspection.to_dict()
                               if self.introspection else None),
+            "numerics": self.numerics.to_dict() if self.numerics else None,
         }
 
     def to_json(self) -> str:
@@ -254,6 +314,8 @@ class MultiLayerConfiguration:
                        if d.get("stability") else None),
             introspection=(TrainingIntrospection.from_dict(d["introspection"])
                            if d.get("introspection") else None),
+            numerics=(TrainingNumerics.from_dict(d["numerics"])
+                      if d.get("numerics") else None),
         )
 
     @staticmethod
@@ -369,6 +431,7 @@ class ListBuilder:
             compute_dtype=self._compute_dtype,
             stability=p._stability,
             introspection=p._introspection,
+            numerics=p._numerics,
         )
 
 
@@ -391,6 +454,7 @@ class Builder:
         self._regularization = False
         self._stability: Optional[TrainingStability] = None
         self._introspection: Optional[TrainingIntrospection] = None
+        self._numerics: Optional[TrainingNumerics] = None
 
     def seed(self, s: int) -> "Builder":
         self._seed = int(s)
@@ -475,6 +539,30 @@ class Builder:
             raise ValueError(
                 f"training_introspection expects True/False/"
                 f"TrainingIntrospection, got {policy!r}")
+        return self
+
+    def training_numerics(self, policy=True, **kwargs) -> "Builder":
+        """Enable the precision-ledger engine (device-side per-layer
+        dynamic-range / format-safety statistics — see
+        ``TrainingNumerics`` / docs/observability.md "Numerics").  Pass
+        a ``TrainingNumerics``, keyword overrides, or ``False`` to
+        disable::
+
+            .training_numerics(absorb_threshold=0.25)
+        """
+        if policy is False or policy is None:
+            if kwargs:
+                raise ValueError("training_numerics(False) takes no kwargs")
+            self._numerics = None
+        elif isinstance(policy, TrainingNumerics):
+            self._numerics = (dataclasses.replace(policy, **kwargs)
+                              if kwargs else policy)
+        elif policy is True:
+            self._numerics = TrainingNumerics(**kwargs)
+        else:
+            raise ValueError(
+                f"training_numerics expects True/False/TrainingNumerics, "
+                f"got {policy!r}")
         return self
 
     def optimization_algo(self, algo: str) -> "Builder":

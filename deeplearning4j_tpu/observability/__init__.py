@@ -52,6 +52,9 @@ from deeplearning4j_tpu.observability.flightrecorder import (
 from deeplearning4j_tpu.observability.introspection import (
     AnomalyMonitor, IntrospectPlan,
 )
+from deeplearning4j_tpu.observability.numerics import (
+    NumericsMonitor, NumericsPlan, format_precision_ledger, kv_page_ledger,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS", "Counter", "Gauge", "Histogram", "MetricFamily",
@@ -75,4 +78,6 @@ __all__ = [
     "dump_flight_report", "get_flight_recorder", "get_watchdog",
     "read_flight_report", "set_flight_recorder", "step_guard",
     "AnomalyMonitor", "IntrospectPlan",
+    "NumericsMonitor", "NumericsPlan", "format_precision_ledger",
+    "kv_page_ledger",
 ]

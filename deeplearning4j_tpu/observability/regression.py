@@ -136,6 +136,17 @@ DEFAULT_RULES: List[Rule] = [
     # a collapse here means the collection fell off the fused path (or a
     # per-report host-sync storm came back).
     Rule("Introspected train step", direction=LOWER, tolerance=0.4),
+    # precision ledger (bench_numerics): the numerics-on fit step must
+    # not drift slower (the range stats ride inside the XLA step like
+    # the introspection reductions); ledger_overhead_ok pins the <5%
+    # overhead contract itself (1 = within budget, direction=higher +
+    # tolerance=0 means any drop to 0 regresses), and the exact-zero
+    # rule pins "enabling the ledger adds NO steady-state recompiles"
+    Rule("Numerics-ledger train step", direction=LOWER, tolerance=0.4),
+    Rule("Numerics-ledger train step", field="ledger_overhead_ok",
+         tolerance=0.0, required=False),
+    Rule("Numerics-ledger train step", field="steady_state_compiles",
+         direction=LOWER, tolerance=0.0, required=False),
     # memory & collective-communication sentinels (bench _memory_measure
     # -> observability.memory.sentinels): FLIPPED to the ZeRO baselines
     # by the update-sharding PR (ROADMAP item 2, arXiv 2004.13336) — the
@@ -177,6 +188,33 @@ DEFAULT_RULES: List[Rule] = [
     Rule("ZeRO DP step time", direction=LOWER, tolerance=0.4),
     Rule("ZeRO DP step time", field="per_device_bytes_ratio",
          direction=LOWER, tolerance=0.1, required=False),
+]
+
+
+# The committed policy over kernel_trust.json (observability.kerneldiff
+# sweeps; ``python -m ...kerneldiff --baseline kernel_trust.json``).
+# Worst-config max-rel-error per kernel: direction=lower with a 1.0
+# tolerance — the CPU-interpret sweep is deterministic, so the slack is
+# for dtype-budget headroom, not jitter; a doubling of any kernel's
+# divergence regresses.  The doc-scope rule pins "no config anywhere
+# fails its budget" exactly (baseline 0, tolerance 0).
+KERNEL_TRUST_RULES: List[Rule] = [
+    Rule("Kernel max rel error (flash_attention)", direction=LOWER,
+         tolerance=1.0),
+    Rule("Kernel max rel error (dot_product_attention)", direction=LOWER,
+         tolerance=1.0),
+    Rule("Kernel max rel error (gather_pages)", direction=LOWER,
+         tolerance=0.0),
+    Rule("Kernel max rel error (paged_attention)", direction=LOWER,
+         tolerance=1.0),
+    Rule("Kernel max rel error (pallas_lrn)", direction=LOWER,
+         tolerance=1.0, required=False),
+    Rule("Kernel max rel error (pallas_bn_inference)", direction=LOWER,
+         tolerance=1.0, required=False),
+    Rule("Kernel max rel error (pallas_bn_training)", direction=LOWER,
+         tolerance=1.0, required=False),
+    Rule("Kernel trust failing configs", scope="doc",
+         field="summary.failing_configs", direction=LOWER, tolerance=0.0),
 ]
 
 

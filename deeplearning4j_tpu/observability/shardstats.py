@@ -313,11 +313,13 @@ def program_analysis(fn, args: Tuple, kwargs: Dict, *,
 # ------------------------------------------------------------------ ledger
 # Reserved updater-state subtrees the ZeRO update sharding keeps
 # REPLICATED (stacked per replica in the wrapper): the stability engine's
-# guard/scale scalars and the introspection stat vectors.  Mirrors
-# ``resilience.stability.STATE_KEY`` / ``observability.introspection
+# guard/scale scalars, the introspection stat vectors, and the numerics
+# precision-ledger vector.  Mirrors ``resilience.stability.STATE_KEY`` /
+# ``observability.introspection.STATE_KEY`` / ``observability.numerics
 # .STATE_KEY`` — literals here so the ledger stays importable without
 # jax; ``tests/test_zero.py`` pins the mirror.
-RESERVED_REPLICATED_SUBTREES = ("__stability__", "__introspect__")
+RESERVED_REPLICATED_SUBTREES = ("__stability__", "__introspect__",
+                                "__numerics__")
 
 
 def zero_shardable(shape, k: int) -> bool:

@@ -255,12 +255,13 @@ class ParallelWrapper:
     def _build(self):
         if self.update_sharding == zero_mod.ZERO:
             return self._build_zero()
-        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.observability import introspection, numerics
 
         net = self.net
         cfg = net.conf.updater
         policy = net.conf.stability
         plan = introspection.plan_for(net)
+        nplan = numerics.plan_for(net)
         lr_overrides = {
             l.name: l.learning_rate for l in net.layers if l.learning_rate is not None
         }
@@ -268,15 +269,23 @@ class ParallelWrapper:
         average_updaters = self.average_updaters
 
         def one_replica_step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
+            nstate = None
+            if nplan is not None:
+                nstate, upd_state = numerics.split_state(upd_state)
             if plan is not None:
                 _, upd_state = introspection.split_state(upd_state)
+            # iteration is unmapped under the vmap, so this predicate
+            # stays a true lax.cond per replica (not a select)
+            now = numerics.collect_now(nplan, iteration)
             kw = ({"collect_acts": True}
-                  if plan is not None and plan.collect_acts else {})
+                  if numerics.wants_acts(plan, nplan) else {})
+            if kw and now is not None:
+                kw["numerics_now"] = now
             if policy is None:
                 (loss, aux), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
                     params, net_state, x, y, rng, fm, lm, None, **kw
                 )
-                new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
+                new_ns, _, act_stats = numerics.unpack_aux(plan, nplan, aux)
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration,
                                              lr_overrides, params=params)
@@ -289,6 +298,9 @@ class ParallelWrapper:
                     new_us, plan, grads=grads, params=params,
                     new_params=new_params, iteration=iteration,
                     act_stats=act_stats)
+                numerics.attach(
+                    new_us, nplan, grads=grads, iteration=iteration,
+                    act_stats=act_stats, prev=nstate, now=now)
                 return new_params, new_us, new_ns, loss, jnp.ones(())
             # non-finite step guard per replica (resilience/stability.py):
             # a poisoned replica's step is a device-side no-op; the window
@@ -299,7 +311,7 @@ class ParallelWrapper:
             (_, (loss, aux)), grads = jax.value_and_grad(
                 stability.scaled_loss(net._loss_fn, stab), has_aux=True)(
                 params, net_state, x, y, rng, fm, lm, None, **kw)
-            new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
+            new_ns, _, act_stats = numerics.unpack_aux(plan, nplan, aux)
             new_params, new_us, new_ns, finite = (
                 stability.apply_guarded_update(
                     policy, cfg, stab, inner, params, net_state,
@@ -308,6 +320,10 @@ class ParallelWrapper:
                 new_us, plan, grads=grads, params=params,
                 new_params=new_params, iteration=iteration,
                 act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
+            numerics.attach(
+                new_us, nplan, grads=grads, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"],
+                prev=nstate, now=now)
             return new_params, new_us, new_ns, loss, finite.astype(jnp.float32)
 
         vstep = jax.vmap(one_replica_step, in_axes=(0, 0, 0, None, 0, 0, 0, 0, 0))
@@ -358,15 +374,16 @@ class ParallelWrapper:
             params_k = jax.tree_util.tree_map(wavg, params_k)
             ns_k = jax.tree_util.tree_map(wavg, ns_k)
             if average_updaters:
-                if plan is not None and introspection.STATE_KEY in upd_k:
-                    # the introspection subtree is the PER-REPLICA view —
-                    # averaging it would erase exactly the per-replica
-                    # divergence signal it exists to expose
-                    intro_k = upd_k[introspection.STATE_KEY]
-                    rest = {k: v for k, v in upd_k.items()
-                            if k != introspection.STATE_KEY}
+                # the introspection and numerics subtrees are PER-REPLICA
+                # views — averaging them would erase exactly the
+                # per-replica divergence signal they exist to expose
+                held = {k: upd_k[k]
+                        for k in (introspection.STATE_KEY, numerics.STATE_KEY)
+                        if k in upd_k}
+                if held:
+                    rest = {k: v for k, v in upd_k.items() if k not in held}
                     rest = jax.tree_util.tree_map(wavg, rest)
-                    rest[introspection.STATE_KEY] = intro_k
+                    rest.update(held)
                     upd_k = rest
                 else:
                     upd_k = jax.tree_util.tree_map(wavg, upd_k)
@@ -393,9 +410,13 @@ class ParallelWrapper:
         and applied to the local shard — reproducing the replicated
         window's average-of-per-replica-updates semantics exactly.  The
         ``__stability__`` / ``__introspect__`` subtrees stay stacked per
-        replica as in replicated mode (recorded in the ledger notes)."""
+        replica as in replicated mode (recorded in the ledger notes).
+        The ``__numerics__`` precision-ledger subtree is carried through
+        UNCHANGED (stale) — ZeRO's sharded update has no per-replica
+        gradient view to measure; harvest reports whatever the last
+        non-ZeRO refresh wrote (docs/observability.md "Numerics")."""
         from deeplearning4j_tpu.backend.compat import shard_map
-        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.observability import introspection, numerics
         from deeplearning4j_tpu.resilience import stability
 
         net = self.net
@@ -418,6 +439,7 @@ class ParallelWrapper:
 
         def fit_window(p_sh, upd_k, ns_k, iteration, xs, ys, rngs, fms, lms,
                        weights):
+            num_k, upd_k = numerics.split_state(upd_k)
             _, upd2 = introspection.split_state(upd_k)
             if policy is not None:
                 stab_k, inner_sh = stability.split_state(upd2)
@@ -566,6 +588,10 @@ class ParallelWrapper:
                 new_upd[introspection.STATE_KEY] = \
                     zero_mod.pack_introspection(plan, iteration, gn_k, un,
                                                 pn, act_k)
+            if num_k is not None:
+                # stale carry-through (see the docstring): structurally
+                # intact so checkpoints and later non-ZeRO fits resume it
+                new_upd[numerics.STATE_KEY] = num_k
             losses = losses_k[None]
             if policy is not None:
                 return (new_p, new_upd, ns_out, losses, 1.0 - fin_f,
@@ -624,6 +650,12 @@ class ParallelWrapper:
             # introspection state must exist BEFORE replica stacking so
             # the per-layer stat vectors ride in upd_k as [K, L]
             introspection.ensure_state(net)
+        numerics_on = getattr(net.conf, "numerics", None) is not None
+        if numerics_on:
+            from deeplearning4j_tpu.observability import numerics
+
+            # precision-ledger state rides in upd_k as [K, N] likewise
+            numerics.ensure_state(net)
         shard = self._replica_sharding()
         params_k, upd_k, ns_k = self._stage(net, K, shard)
         # sharding ledger over the staged trees, measured against the
@@ -758,6 +790,11 @@ class ParallelWrapper:
                 # device reference only, no transfer until a listener's
                 # reporting interval actually reads it
                 net._introspect_live = upd_k.get(introspection.STATE_KEY)
+            if numerics_on:
+                from deeplearning4j_tpu.observability import numerics
+
+                # stacked [K, N] per-replica precision-ledger view
+                net._numerics_live = upd_k.get(numerics.STATE_KEY)
             if net.listeners:
                 # fire the facade's listeners once per averaging window
                 # (reference ParallelWrapper notifies per iteration) with

@@ -1049,11 +1049,20 @@ class PipelineParallelTrainingMaster(TrainingMaster):
             from deeplearning4j_tpu.observability import introspection
 
             intro_held = net.updater_state.pop(introspection.STATE_KEY, None)
+        num_held = None
+        if getattr(net.conf, "numerics", None) is not None:
+            # the layerless __numerics__ precision-ledger subtree is
+            # parked for the same reason — stale over a pipeline fit
+            from deeplearning4j_tpu.observability import numerics
+
+            num_held = net.updater_state.pop(numerics.STATE_KEY, None)
         try:
             return self._execute_with_master(net, iterator, res)
         finally:
             if intro_held is not None:
                 net.updater_state[introspection.STATE_KEY] = intro_held
+            if num_held is not None:
+                net.updater_state[numerics.STATE_KEY] = num_held
 
     def _execute_with_master(self, net, iterator, res):
         from deeplearning4j_tpu.resilience import preemption_requested

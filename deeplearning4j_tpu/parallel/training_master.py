@@ -197,12 +197,13 @@ class SyncTrainingMaster(TrainingMaster):
         return NamedSharding(self.mesh, P())
 
     def _build(self, net):
-        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.observability import introspection, numerics
         from deeplearning4j_tpu.resilience import stability
 
         cfg = net.conf.updater
         policy = net.conf.stability
         plan = introspection.plan_for(net)
+        nplan = numerics.plan_for(net)
         lr_overrides = {
             l.name: l.learning_rate for l in net.layers if l.learning_rate is not None
         }
@@ -213,12 +214,14 @@ class SyncTrainingMaster(TrainingMaster):
         players = self._param_layout(net)
         # updater state mirrors the param tree per slot ({"m": ..., "v": ...})
         # but only over TRAINABLE layers — restrict to the state's own keys.
-        # The stability and introspection subtrees are plain scalars/small
-        # vectors: replicated, like the rest of the non-param step state.
+        # The stability, introspection and numerics subtrees are plain
+        # scalars/small vectors: replicated, like the rest of the non-param
+        # step state.
         if isinstance(players, dict) and net.updater_state:
             ulayers: Any = {
                 slot: (repl if slot in (stability.STATE_KEY,
-                                        introspection.STATE_KEY)
+                                        introspection.STATE_KEY,
+                                        numerics.STATE_KEY)
                        else {ln: players[ln] for ln in tree})
                 for slot, tree in net.updater_state.items()
             }
@@ -228,15 +231,21 @@ class SyncTrainingMaster(TrainingMaster):
             ulayers = players
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
+            nstate = None
+            if nplan is not None:
+                nstate, upd_state = numerics.split_state(upd_state)
             if plan is not None:
                 _, upd_state = introspection.split_state(upd_state)
+            now = numerics.collect_now(nplan, iteration)
             kw = ({"collect_acts": True}
-                  if plan is not None and plan.collect_acts else {})
+                  if numerics.wants_acts(plan, nplan) else {})
+            if kw and now is not None:
+                kw["numerics_now"] = now
             if policy is None:
                 (loss, aux), grads = jax.value_and_grad(net._loss_fn, has_aux=True)(
                     params, net_state, x, y, rng, fm, lm, None, **kw
                 )
-                new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
+                new_ns, _, act_stats = numerics.unpack_aux(plan, nplan, aux)
                 grads = {k: v for k, v in grads.items() if v}
                 updates, new_us = upd.update(cfg, grads, upd_state, iteration,
                                              lr_overrides, params=params)
@@ -252,6 +261,9 @@ class SyncTrainingMaster(TrainingMaster):
                     new_us, plan, grads=grads, params=params,
                     new_params=new_params, iteration=iteration,
                     act_stats=act_stats)
+                numerics.attach(
+                    new_us, nplan, grads=grads, iteration=iteration,
+                    act_stats=act_stats, prev=nstate, now=now)
                 return new_params, new_us, new_ns, loss
             # stability engine (resilience/stability.py): poisoned ROWS are
             # zeroed before the forward (NaN activations poison the
@@ -272,7 +284,7 @@ class SyncTrainingMaster(TrainingMaster):
             (_, (loss, aux)), grads = jax.value_and_grad(
                 stability.scaled_loss(net._loss_fn, stab), has_aux=True)(
                 params, net_state, x, y, rng, fm, lm, None, **kw)
-            new_ns, _, act_stats = introspection.unpack_aux(plan, aux)
+            new_ns, _, act_stats = numerics.unpack_aux(plan, nplan, aux)
             # an all-rows-poisoned batch yields a zero loss and zero
             # gradients — finite, but updating would still decay Adam
             # moments toward the pad; veto it
@@ -284,6 +296,10 @@ class SyncTrainingMaster(TrainingMaster):
                 new_us, plan, grads=grads, params=params,
                 new_params=new_params, iteration=iteration,
                 act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
+            numerics.attach(
+                new_us, nplan, grads=grads, iteration=iteration,
+                act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"],
+                prev=nstate, now=now)
             return (new_params, new_us, new_ns, loss,
                     stability.slot_poison_flags(row_ok, K))
 
@@ -316,9 +332,14 @@ class SyncTrainingMaster(TrainingMaster):
         normalization norms and finiteness reductions come out global
         automatically).  Params and Adam moments live sharded; the
         ``__stability__`` / ``__introspect__`` subtrees stay replicated
-        (the choice is recorded in the sharding ledger's notes)."""
+        (the choice is recorded in the sharding ledger's notes).  The
+        ``__numerics__`` precision-ledger subtree is carried through
+        UNCHANGED (stale): its max-abs / fraction stats do not merge
+        correctly across per-shard activation views (a pmean of
+        per-shard maxes is not the global max), so harvest reports the
+        last non-ZeRO refresh (docs/observability.md "Numerics")."""
         from deeplearning4j_tpu.backend.compat import shard_map
-        from deeplearning4j_tpu.observability import introspection
+        from deeplearning4j_tpu.observability import introspection, numerics
         from deeplearning4j_tpu.resilience import stability
 
         if type(self)._param_layout is not SyncTrainingMaster._param_layout:
@@ -348,6 +369,7 @@ class SyncTrainingMaster(TrainingMaster):
         AX = zero_mod.AXIS
 
         def step(params, upd_state, net_state, iteration, x, y, rng, fm, lm):
+            num_held, upd_state = numerics.split_state(upd_state)
             if plan is not None:
                 _, upd_state = introspection.split_state(upd_state)
             if policy is not None:
@@ -425,6 +447,9 @@ class SyncTrainingMaster(TrainingMaster):
                     new_us, plan, grads=g_sh, params=params,
                     new_params=new_params, iteration=iteration,
                     act_stats=act_stats)
+                if num_held is not None:
+                    # stale carry-through (see the docstring)
+                    new_us[numerics.STATE_KEY] = num_held
                 return new_params, new_us, new_ns, gloss
             # guarded tail on the SHARDED trees: the all-poisoned-batch
             # veto and the device-side skip mask work unchanged (the
@@ -437,6 +462,9 @@ class SyncTrainingMaster(TrainingMaster):
                 new_us, plan, grads=g_sh, params=params,
                 new_params=new_params, iteration=iteration,
                 act_stats=act_stats, grad_scale=1.0 / stab["loss_scale"])
+            if num_held is not None:
+                # stale carry-through (see the docstring)
+                new_us[numerics.STATE_KEY] = num_held
             return (new_params, new_us, new_ns, gloss,
                     stability.slot_poison_flags(row_ok, K))
 
@@ -497,6 +525,12 @@ class SyncTrainingMaster(TrainingMaster):
             # placement so the stat vectors ride in upd_state (replicated
             # under _upd_layout)
             introspection.ensure_state(net)
+        numerics_on = getattr(net.conf, "numerics", None) is not None
+        if numerics_on:
+            from deeplearning4j_tpu.observability import numerics
+
+            # precision-ledger state likewise rides replicated
+            numerics.ensure_state(net)
         if self._step is None:
             if self.update_sharding == zero_mod.ZERO:
                 self._build_zero(net)
@@ -612,6 +646,10 @@ class SyncTrainingMaster(TrainingMaster):
                 # updater_state is stale until the loop exits); no
                 # transfer until a reporting interval reads it
                 net._introspect_live = upd_state[introspection.STATE_KEY]
+            if numerics_on:
+                from deeplearning4j_tpu.observability import numerics
+
+                net._numerics_live = upd_state[numerics.STATE_KEY]
             net.score_value = loss  # device scalar; fetched lazily on read
             net.iteration += 1
             if stab_rt is not None:

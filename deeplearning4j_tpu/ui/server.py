@@ -307,6 +307,21 @@ class UIServer:
             out["series"][layer] = entry
         return out
 
+    def numerics_report(self, sid: str) -> Dict[str, Any]:
+        """The most recent precision-ledger harvest of one session plus
+        its rendered operator table (``GET /train/numerics``)."""
+        from deeplearning4j_tpu.observability import numerics
+        ups = self.storage.get_updates(sid)
+        latest = None
+        for u in reversed(ups):
+            if getattr(u, "numerics", None):
+                latest = u.numerics
+                break
+        if latest is None:
+            return {"numerics": None, "ledger": None}
+        return {"numerics": latest,
+                "ledger": numerics.format_precision_ledger(latest)}
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> int:
         storage = self.storage
@@ -395,6 +410,8 @@ class UIServer:
                         self._json(ui.layer_detail(sid, layer))
                 elif path.endswith("/train/introspection"):
                     self._json(ui.introspection_series(params.get("sid")))
+                elif path.endswith("/train/numerics"):
+                    self._json(ui.numerics_report(params.get("sid")))
                 elif path in ("/", "/train", "/train/"):
                     body = _PAGE.encode()
                     self.send_response(200)

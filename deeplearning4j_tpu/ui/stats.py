@@ -61,6 +61,11 @@ class StatsUpdateConfiguration:
     # the AnomalyMonitor rules on each harvested report
     collect_introspection: bool = True
     anomaly_detection: bool = True
+    # precision ledger (device-side per-layer dynamic-range / format-
+    # safety stats, docs/observability.md "Numerics"): harvested into
+    # the report when the model's conf enables it; anomaly_detection
+    # also runs the NumericsMonitor format-safety rules on each harvest
+    collect_numerics: bool = True
 
 
 @dataclass
@@ -103,6 +108,11 @@ class StatsReport:
     update_stats: Dict[str, Any] = field(default_factory=dict)
     activation_stats: Dict[str, Any] = field(default_factory=dict)
     replicas: Optional[int] = None
+    # precision ledger (device-computed, one transfer per report):
+    # {"iteration", "loss_scale", "gradients"/"moments"/"activations":
+    # {layer: {"max_abs", "underflow", "overflow",
+    # "exponent_histogram", "verdicts"}}}
+    numerics: Dict[str, Any] = field(default_factory=dict)
 
     def to_json(self) -> str:
         return json.dumps({"type": "update", **asdict(self)})
@@ -214,6 +224,7 @@ class StatsListener(IterationListener):
         self.config = config or StatsUpdateConfiguration()
         self.registry = registry
         self._anomaly = anomaly_monitor   # lazily defaulted on first use
+        self._num_anomaly = None          # NumericsMonitor, same lifecycle
         self._last_time: Optional[float] = None
         self._initialized = False
 
@@ -276,6 +287,8 @@ class StatsListener(IterationListener):
                     model.params, cfg.num_histogram_bins)).items()}
         if cfg.collect_introspection:
             self._collect_introspection(model, rep, iteration)
+        if cfg.collect_numerics:
+            self._collect_numerics(model, rep, iteration)
         self.storage.put_update(rep)
 
     def _collect_introspection(self, model, rep: StatsReport,
@@ -299,6 +312,26 @@ class StatsListener(IterationListener):
                 self._anomaly = introspection.AnomalyMonitor(
                     component=type(model).__name__)
             self._anomaly.check(harvested, iteration=iteration)
+
+    def _collect_numerics(self, model, rep: StatsReport,
+                          iteration: int) -> None:
+        """Harvest the device-side precision ledger (one batched
+        transfer), embed it in the report, mirror the
+        ``dl4j_layer_overflow_risk`` / ``dl4j_layer_max_abs`` gauges,
+        and run the format-safety rules.  A model without
+        ``conf.numerics`` contributes nothing."""
+        from deeplearning4j_tpu.observability import numerics
+
+        harvested = numerics.harvest_model(model)
+        if harvested is None:
+            return
+        rep.numerics = harvested
+        numerics.publish_metrics(harvested, registry=self.registry)
+        if self.config.anomaly_detection:
+            if self._num_anomaly is None:
+                self._num_anomaly = numerics.NumericsMonitor(
+                    component=type(model).__name__)
+            self._num_anomaly.check(harvested, iteration=iteration)
 
 
 class HistogramIterationListener(StatsListener):

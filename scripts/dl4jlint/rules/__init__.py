@@ -7,6 +7,7 @@ from __future__ import annotations
 from typing import List, Sequence
 
 from scripts.dl4jlint.core import Rule
+from scripts.dl4jlint.rules.dtype_widening import DtypeWideningRule
 from scripts.dl4jlint.rules.host_sync import HostSyncRule
 from scripts.dl4jlint.rules.lock_discipline import LockDisciplineRule
 from scripts.dl4jlint.rules.metrics_docs import MetricsDocsRule
@@ -19,6 +20,7 @@ ALL_RULES: List[Rule] = [
     RecompileHazardRule(),
     LockDisciplineRule(),
     RngReuseRule(),
+    DtypeWideningRule(),
     ThreadHygieneRule(),
     MetricsDocsRule(),
 ]

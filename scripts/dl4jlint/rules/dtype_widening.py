@@ -1,0 +1,133 @@
+"""implicit-dtype-widening: float64 sneaking into device math.
+
+The repo runs jax with x64 DISABLED (the TPU default): a ``float64``
+request inside traced code is silently truncated to float32, so the
+source claims a precision the computation never delivers — the exact
+mismatch the precision ledger (observability/numerics.py) exists to
+surface.  Worse, host-numpy reductions inside a traced function
+(``np.sum(tracer)``) either break tracing outright or force the value
+to host and promote it to float64, producing a reference that can never
+agree bit-for-bit with the device result.
+
+Two checks:
+
+1. **Inside jit-traced functions** (the ``jitscan`` inventory): any
+   float64 request — ``np.float64(x)``, ``.astype(np.float64)`` /
+   ``.astype("float64")``, a ``dtype=float64`` keyword — and any
+   host-numpy reduction (``np.sum`` / ``np.mean`` / ``np.dot`` / ...)
+   whose result would be float64 on host.
+2. **Corpus-wide**: ``dtype=float64`` passed to a ``jnp.`` / ``jax.``
+   constructor — with x64 off jax warns once and hands back float32,
+   so the annotation is dead weight at best and a portability trap at
+   worst.
+
+Deliberately NOT flagged: ``np.float64`` in plain host code — the
+kernel-trust harness (observability/kerneldiff.py) builds float64
+numpy references BY DESIGN, and host-side accumulators widening to
+float64 is correct numerics, not a bug.  The hazard is float64 *near
+the device boundary*, not float64 itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from scripts.dl4jlint.core import FileContext, Finding, Rule, dotted_name
+from scripts.dl4jlint import jitscan
+
+_F64_NAMES = {"np.float64", "numpy.float64", "onp.float64",
+              "jnp.float64", "jax.numpy.float64", "float64"}
+_NP_PREFIXES = ("np.", "numpy.", "onp.")
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
+# host-numpy ops that return float64 from float32 input (dtype-promoting
+# reductions and contractions) — inside a traced fn these also force the
+# tracer to host
+_NP_REDUCTIONS = {"sum", "mean", "std", "var", "prod", "dot", "einsum",
+                  "linalg.norm", "median", "average", "trapz"}
+
+
+def _is_float64_expr(node: ast.AST) -> bool:
+    """``np.float64`` / ``"float64"`` / ``'f8'`` as an expression."""
+    if isinstance(node, ast.Constant) and node.value in ("float64", "f8",
+                                                         "double"):
+        return True
+    return dotted_name(node) in _F64_NAMES
+
+
+def _widening_call(node: ast.AST) -> Optional[str]:
+    """What (if anything) this Call does that requests float64."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    # x.astype(np.float64) / x.astype("float64")
+    if (isinstance(func, ast.Attribute) and func.attr == "astype"
+            and node.args and _is_float64_expr(node.args[0])):
+        return ".astype(float64)"
+    # np.float64(x) — a conversion, not a bare dtype reference
+    if dotted_name(func) in _F64_NAMES and node.args:
+        return "float64(...) conversion"
+    # any call carrying dtype=float64
+    for kw in node.keywords:
+        if kw.arg == "dtype" and _is_float64_expr(kw.value):
+            return "dtype=float64 keyword"
+    return None
+
+
+def _np_reduction(node: ast.AST) -> Optional[str]:
+    if not isinstance(node, ast.Call):
+        return None
+    d = dotted_name(node.func)
+    if d is None:
+        return None
+    for prefix in _NP_PREFIXES:
+        if d.startswith(prefix) and d[len(prefix):] in _NP_REDUCTIONS:
+            return d
+    return None
+
+
+class DtypeWideningRule(Rule):
+    name = "implicit-dtype-widening"
+    description = ("float64 requests in jit-traced code (silently f32 "
+                   "under x64-off) and host-numpy reductions inside "
+                   "traced functions")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen: set = set()
+
+        def emit(node: ast.AST, message: str) -> None:
+            if node.lineno in seen:
+                return
+            seen.add(node.lineno)
+            findings.append(self.finding(ctx, node.lineno, message))
+
+        scan = jitscan.scan(ctx)
+        traced_nodes: set = set()
+        for fn in scan.traced:
+            for node in ast.walk(fn):
+                traced_nodes.add(id(node))
+                what = _widening_call(node)
+                if what:
+                    emit(node, f"{what} inside a jit-traced function — "
+                         "x64 is off, this computes in float32 while the "
+                         "source claims float64")
+                    continue
+                red = _np_reduction(node)
+                if red:
+                    emit(node, f"host-numpy {red}() inside a jit-traced "
+                         "function — breaks tracing (or silently promotes "
+                         "to float64 on host)")
+        # corpus-wide: dtype=float64 handed to a jnp/jax constructor
+        for node in ctx.nodes:
+            if id(node) in traced_nodes or not isinstance(node, ast.Call):
+                continue
+            d = dotted_name(node.func)
+            if d is None or not d.startswith(_JNP_PREFIXES):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "dtype" and _is_float64_expr(kw.value):
+                    emit(node, f"{d}(dtype=float64) — jax with x64 off "
+                         "returns float32; drop the annotation or build "
+                         "the reference with host numpy")
+        return findings

@@ -94,6 +94,12 @@ def pytest_configure(config):
         "paged KV cache with prefix sharing, iteration-level join/leave "
         "scheduling, zero-recompile decode, hot-swap under decode load, "
         "streaming HTTP surface (python -m pytest -m generation)")
+    config.addinivalue_line(
+        "markers",
+        "numerics: precision-observability tests — the in-graph "
+        "precision ledger (dynamic-range stats, format-safety verdicts, "
+        "spike drill), KV-page range stats, and the kernel-trust "
+        "differential harness (python -m pytest -m numerics)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,25 @@
+"""True positives for implicit-dtype-widening (parsed, never executed)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(params, x):
+    acc = jnp.zeros((4,), dtype=np.float64)   # f32 under x64-off
+    h = (params * x).astype("float64")        # silent truncation to f32
+    return acc + np.mean(h)                   # host reduction on a tracer
+
+
+def wrapped(params, x):
+    scale = np.float64(0.5)                   # conversion in traced code
+    return (params * scale * x).sum()
+
+
+step = jax.jit(wrapped)
+
+
+def build_reference():
+    # corpus-wide check: jnp constructor asking for a dtype jax
+    # (x64 off) will never give it
+    return jnp.arange(16, dtype="float64")

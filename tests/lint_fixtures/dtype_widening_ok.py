@@ -1,0 +1,22 @@
+"""Clean corpus for implicit-dtype-widening (parsed, never executed)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated_step(params, x):
+    # in-graph math stays in f32; jnp reductions are fine
+    h = (params * x).astype(jnp.float32)
+    return jnp.mean(h) + jnp.sum(h ** 2)
+
+
+def host_reference(x):
+    # float64 in PLAIN host code is correct numerics, not a finding —
+    # the kernel-trust harness builds f64 numpy references on purpose
+    a = np.asarray(x, dtype=np.float64)
+    return np.sum(a) / np.float64(a.size)
+
+
+def device_side():
+    return jnp.zeros((8,), dtype=jnp.float32)

@@ -522,8 +522,9 @@ def test_sliding_window_ring_matches_exact():
     import functools
 
     from jax.sharding import Mesh
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.backend.compat import shard_map
 
     from deeplearning4j_tpu.backend import device as backend
     from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
@@ -552,8 +553,9 @@ def test_gqa_window_flash_and_ring_paths(interpret_helper):
     import functools
 
     from jax.sharding import Mesh
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.backend.compat import shard_map
 
     from deeplearning4j_tpu.backend import device as backend
     from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer

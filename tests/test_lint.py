@@ -96,6 +96,19 @@ def test_rng_reuse_rule():
     assert "loop_carried" in symbols                # caught on 2nd pass
 
 
+def test_dtype_widening_rule():
+    found = fixture_pair("implicit-dtype-widening",
+                         "dtype_widening_bad.py", "dtype_widening_ok.py")
+    msgs = " | ".join(f.message for f in found)
+    assert "inside a jit-traced function" in msgs
+    assert "host-numpy np.mean()" in msgs
+    assert "dtype=float64" in msgs
+    symbols = {f.symbol for f in found}
+    assert "decorated_step" in symbols      # astype/dtype kw/np.mean
+    assert "wrapped" in symbols             # np.float64() via jax.jit(wrapped)
+    assert "build_reference" in symbols     # corpus-wide jnp dtype check
+
+
 def test_thread_hygiene_rule():
     found = fixture_pair("thread-hygiene", "thread_bad.py", "thread_ok.py")
     msgs = " | ".join(f.message for f in found)
@@ -114,7 +127,7 @@ def test_rule_registry_complete():
     names = {r.name for r in ALL_RULES}
     assert names == {"host-sync-in-hot-path", "recompile-hazard",
                      "lock-discipline", "rng-key-reuse", "thread-hygiene",
-                     "metrics-docs"}
+                     "implicit-dtype-widening", "metrics-docs"}
     with pytest.raises(KeyError):
         get_rules(["no-such-rule"])
 

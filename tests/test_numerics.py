@@ -1,0 +1,392 @@
+"""Numerics observability (docs/observability.md "Numerics"): the
+in-graph precision ledger, its sampling/exactness contract, interval
+gating, the format-safety verdicts, the KV-page range stats, the
+kernel-trust differential harness, and the spike drill.
+
+Acceptance oracles (ISSUE 16):
+
+- the device-side stat blocks match a numpy oracle exactly when the
+  sample budget is off, and max-abs stays EXACT under sampling (a
+  planted outlier the stride misses still trips the hard overflow
+  flag);
+- a ledger-on fit is BIT-IDENTICAL to a ledger-off fit with zero
+  recompiles after the first step;
+- interval-gated collection carries the stale snapshot through
+  off-steps and refreshes exactly on the interval;
+- `FaultInjector.poison_gradients(mode="spike")` flips a healthy
+  layer's bf16 verdict and fires the `numerics_anomaly` flight event;
+- the kernel-trust harness runs its CPU sweep and the exact kernels
+  measure exactly zero error;
+- the policy serializes with the model configuration.
+"""
+
+import json
+import math
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf import (
+    NeuralNetConfiguration, TrainingNumerics,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observability import get_flight_recorder, get_registry
+from deeplearning4j_tpu.observability import kerneldiff, numerics
+from deeplearning4j_tpu.resilience import FaultInjector, inject_faults
+
+pytestmark = pytest.mark.numerics
+
+
+def counter_value(name, **labels):
+    fam = get_registry().get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for label_pairs, child in fam.samples():
+        d = dict(label_pairs)
+        if all(d.get(k) == v for k, v in labels.items()):
+            total += child.value
+    return total
+
+
+def flight_events(kind, **attrs):
+    return [ev for ev in get_flight_recorder().events()
+            if ev.kind == kind
+            and all(ev.attrs.get(k) == v for k, v in attrs.items())]
+
+
+def make_net(seed=1, num=True, updater="sgd", activation="tanh",
+             **policy_kw):
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .updater(updater, learning_rate=0.01))
+    if num:
+        b.training_numerics(**policy_kw)
+    conf = (b.list()
+            .layer(DenseLayer(n_in=6, n_out=10, activation=activation))
+            .layer(OutputLayer(n_in=10, n_out=3, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def batch(seed=0, n=24, scale=1.0):
+    rs = np.random.RandomState(seed)
+    x = (scale * rs.rand(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rs.randint(0, 3, n)]
+    return x, y
+
+
+def entry_dict(block):
+    """numerics._entry_host on a device [ENTRY] block."""
+    return numerics._entry_host(np.asarray(jax.device_get(block)))
+
+
+# --------------------------------------------------------- the numpy oracle
+
+def oracle(arrs):
+    """Host-side reference for one exact (sample=0) stat block."""
+    flat = np.concatenate([np.asarray(a, np.float32).ravel() for a in arrs])
+    a = np.abs(flat)
+    n = float(a.size)
+    max_abs = float(a.max()) if a.size else 0.0
+    nz = a > 0
+    under, over = {}, {}
+    for name, lo, hi in numerics.FORMATS:
+        if name == "int8":
+            under[name] = float(np.sum(nz & (a < max_abs / 254.0)) / n)
+            over[name] = 0.0
+        else:
+            under[name] = float(np.sum(nz & (a < lo)) / n)
+            over[name] = float(np.sum(a > hi) / n)
+    hist = np.zeros(numerics.HIST_BINS)
+    e = np.floor(np.log2(np.where(nz, a, 1.0)))
+    idx = np.clip(e - numerics.HIST_LO, 0, numerics.HIST_BINS - 1)
+    for i, keep in zip(idx.astype(int), nz):
+        if keep:
+            hist[i] += 1
+    return max_abs, under, over, hist
+
+
+def test_entry_stats_matches_numpy_oracle():
+    rs = np.random.RandomState(7)
+    # exponents spanning subnormal-for-fp16 through past-fp16-max, plus
+    # exact zeros (must not count as underflow or enter the histogram).
+    # NO float32 subnormals: XLA CPU flushes them to zero in comparisons
+    # (1e-40 > 0 is False under jit), so the ledger treats them as zeros
+    # — host numpy does not, and the oracle would disagree.
+    arrs = [
+        (rs.randn(40, 3) * np.exp2(rs.randint(-30, 18, (40, 3)))
+         ).astype(np.float32),
+        np.zeros((11,), np.float32),
+        np.array([1e-30, 7e4, 0.5], np.float32),
+    ]
+    block = jax.jit(
+        lambda t: numerics._entry_stats(t, sample=0))(list(arrs))
+    got = entry_dict(block)
+    max_abs, under, over, hist = oracle(arrs)
+    assert got["max_abs"] == pytest.approx(max_abs, rel=1e-6)
+    for name in numerics.FORMAT_NAMES:
+        assert got["underflow"][name] == pytest.approx(under[name],
+                                                       abs=1e-6), name
+        assert got["overflow"][name] == pytest.approx(over[name],
+                                                      abs=1e-6), name
+    assert np.allclose(got["exponent_histogram"], hist)
+    # the histogram counts exactly the nonzero elements
+    assert sum(got["exponent_histogram"]) == int(np.sum(
+        np.abs(np.concatenate([a.ravel() for a in arrs])) > 0))
+
+
+def test_sampled_stats_keep_max_abs_exact():
+    """The design contract: fractions/histogram may sample, max-abs may
+    not — a single planted outlier at an off-stride index must still
+    trip the hard fp16 overflow flag."""
+    a = np.full((10_000,), 0.5, np.float32)
+    a[3] = 1e6          # stride for sample=1024 is 10, index 3 is unsampled
+    block = jax.jit(
+        lambda t: numerics._entry_stats(t, sample=1024))([a])
+    got = entry_dict(block)
+    assert got["max_abs"] == pytest.approx(1e6)
+    assert got["overflow"]["float16"] == 0.0      # the sample missed it...
+    assert numerics.overflow_hard(got, "float16")  # ...the exact pass didn't
+    assert numerics.risk_score(got, "float16") == 1.0
+    assert not numerics.verdicts(got)["float16"]
+    # and the sampled fractions are computed over the strided subset
+    assert sum(got["exponent_histogram"]) == 1000
+
+
+def test_verdict_thresholds():
+    healthy = {
+        "max_abs": 1.0,
+        "underflow": {n: 0.0 for n in numerics.FORMAT_NAMES},
+        "overflow": {n: 0.0 for n in numerics.FORMAT_NAMES},
+        "exponent_histogram": [0.0] * numerics.HIST_BINS,
+    }
+    healthy["exponent_histogram"][0 - numerics.HIST_LO] = 100.0
+    assert all(numerics.verdicts(healthy).values())
+    assert numerics.risk_score(healthy, "bfloat16") == 0.0
+    # absorption: values 2^-20 next to a 2^0 max are below the bf16 (8
+    # mantissa bits) cutoff but inside fp16's 11 bits? no — 20 > 11:
+    # both absorb; fp8 (4 bits) certainly
+    wide = dict(healthy)
+    wide["exponent_histogram"] = [0.0] * numerics.HIST_BINS
+    wide["exponent_histogram"][0 - numerics.HIST_LO] = 40.0
+    wide["exponent_histogram"][-20 - numerics.HIST_LO] = 60.0
+    assert numerics.absorption_fraction(wide, "bfloat16") == pytest.approx(0.6)
+    assert not numerics.verdicts(wide)["bfloat16"]
+    assert numerics.verdicts(wide, TrainingNumerics(absorb_threshold=0.7)
+                             )["bfloat16"]
+
+
+# ------------------------------------------------- in-step collection
+
+def test_bit_identical_and_zero_recompiles():
+    """Ledger on (collecting EVERY step) vs off: params bit-identical,
+    zero compiles/recompiles after the first step."""
+    x, y = batch()
+    on = make_net(num=True, interval=1)
+    off = make_net(num=False)
+    on.fit(x, y)
+    off.fit(x, y)
+    c0 = counter_value("dl4j_compiles_total")
+    r0 = counter_value("dl4j_recompiles_total")
+    for _ in range(6):
+        on.fit(x, y)
+        off.fit(x, y)
+    assert counter_value("dl4j_compiles_total") == c0
+    assert counter_value("dl4j_recompiles_total") == r0
+    for a, b in zip(jax.tree_util.tree_leaves(on.params),
+                    jax.tree_util.tree_leaves(off.params)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    h = numerics.harvest_model(on)
+    assert h["iteration"] == on.iteration - 1
+    assert set(h["gradients"]) == {"layer_0", "layer_1"}
+    assert set(h["activations"]) == {"layer_0", "layer_1"}
+    for e in h["gradients"].values():
+        assert math.isfinite(e["max_abs"]) and e["max_abs"] > 0
+        assert set(e["verdicts"]) == set(numerics.FORMAT_NAMES)
+
+
+def test_interval_gating_stale_carry():
+    """interval=3: harvests between collection steps return the stale
+    snapshot (same iteration stamp), and the refresh lands exactly on
+    the interval — with zero recompiles across the boundary."""
+    x, y = batch()
+    net = make_net(num=True, interval=3)
+    net.fit(x, y)                      # iteration 0: collected
+    c0 = counter_value("dl4j_compiles_total")
+    assert numerics.harvest_model(net)["iteration"] == 0
+    net.fit(x, y)                      # iteration 1: stale carry
+    net.fit(x, y)                      # iteration 2: stale carry
+    assert numerics.harvest_model(net)["iteration"] == 0
+    net.fit(x, y)                      # iteration 3: collected
+    assert numerics.harvest_model(net)["iteration"] == 3
+    assert counter_value("dl4j_compiles_total") == c0
+
+
+def test_moment_entries_under_adam():
+    x, y = batch()
+    net = make_net(num=True, updater="adam", interval=1)
+    for _ in range(3):
+        net.fit(x, y)
+    h = numerics.harvest_model(net)
+    for e in h["moments"].values():
+        assert e["max_abs"] > 0        # m and v both measured post-update
+
+
+# ------------------------------------------------------------- spike drill
+
+def test_spike_drill_flips_bf16_verdict_and_flight_event():
+    """The fire drill: healthy fit -> bf16-safe gradients; a spike-mode
+    poison (features x1e4) widens the within-layer dynamic range past
+    the absorption threshold, the bf16 verdict flips, and
+    NumericsMonitor fires the numerics_anomaly flight event naming the
+    layer.  Relu, not tanh: a tanh saturated by the spike has exactly
+    zero derivative in f32, which *kills* the layer-0 gradients instead
+    of widening them — the drill would silently pass the healthy check.
+    With relu the W grads blow up ~x1e4 while the bias grads stay O(1)
+    in the same stat block: a ~2^15 within-block spread, so the small
+    half of the block falls below max_exp - 8 bf16 mantissa bits and
+    the absorption fraction crosses the 0.15 drill threshold."""
+    x, y = batch(scale=1.0)
+    net = make_net(num=True, interval=1, absorb_threshold=0.15,
+                   activation="relu")
+    for _ in range(3):
+        net.fit(x, y)
+    before = numerics.harvest_model(net)
+    safe_before = {(c, l) for c in ("gradients", "activations")
+                   for l, e in before[c].items()
+                   if e["verdicts"]["bfloat16"]}
+    assert safe_before, "healthy run must have bf16-safe blocks"
+
+    inj = FaultInjector().poison_gradients("0", at_step=net.iteration,
+                                           mode="spike")
+    with inject_faults(inj):
+        net.fit(x, y)
+    after = numerics.harvest_model(net)
+    assert after["iteration"] == net.iteration - 1
+    flipped = [(c, l) for (c, l) in safe_before
+               if not after[c][l]["verdicts"]["bfloat16"]]
+    assert flipped, "spike did not flip any bf16 verdict"
+    # the spike is visible in the exact max-abs, not just the verdicts
+    grew = max(after[c][l]["max_abs"] / max(before[c][l]["max_abs"], 1e-30)
+               for (c, l) in safe_before)
+    assert grew > 1e2
+
+    monitor = numerics.NumericsMonitor(component="drill", min_iteration=0,
+                                       warn=lambda *a, **k: None)
+    violations = monitor.check(after)
+    assert violations
+    layer = violations[0]["layer"]
+    evs = flight_events("numerics_anomaly", component="drill", layer=layer)
+    assert evs, "no numerics_anomaly flight event recorded"
+
+
+# ------------------------------------------------------------ KV-page stats
+
+def test_kv_page_ledger_under_generation_engine():
+    from deeplearning4j_tpu.generation import GenerationEngine
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    lm = transformer_char_lm(vocab_size=29, d_model=32, n_heads=4,
+                             layers=2, max_cache=128)
+    eng = GenerationEngine(lm, slots=2, page_size=4, max_context=32,
+                           max_queue=16, deadline_s=30.0)
+    eng.start()
+    try:
+        toks = eng.generate([1, 2, 3, 4, 5], 8)
+        assert len(toks) > 0
+        # full pool view: generate() released its pages on completion,
+        # but the written values are still in the pool
+        full = eng.kv_numerics(allocated_only=False)
+        live = eng.kv_numerics()
+    finally:
+        eng.stop()
+    assert full, "no pageable attention layers reported"
+    for pools in full.values():
+        assert set(pools) == {"pk", "pv"}
+        for e in pools.values():
+            assert e["pages"], "non-trash pages must be listed"
+            # written pages carry real values; the per-page max-abs
+            # spread is the int8 per-page-scale decision input
+            assert max(e["page_max_abs"]) > 0
+            assert all(0.0 <= u <= 1.0 for u in e["int8_underflow"])
+            assert 0.0 <= e["int8_ready_fraction"] <= 1.0
+    # allocated-only view is a subset (possibly empty: the request freed
+    # its pages when it completed) with the same schema
+    for layer, pools in live.items():
+        for leaf, e in pools.items():
+            assert set(e["pages"]) <= set(full[layer][leaf]["pages"])
+
+
+# --------------------------------------------------------- kernel trust
+
+def test_kerneldiff_cpu_smoke():
+    report = kerneldiff.run_sweep(
+        kernels=["dot_product_attention", "gather_pages",
+                 "pallas_bn_inference"])
+    assert report["summary"]["kernels"] == 3
+    ks = report["kernels"]
+    # gather is pure indexing: exactly zero error, bit-for-bit
+    assert ks["gather_pages"]["max_rel_error"] == 0.0
+    assert ks["gather_pages"]["classification"] == "within_tolerance"
+    for k in ks.values():
+        assert k["trusted"], k
+        for cfg in k["configs"]:
+            assert cfg["status"] == "pass", cfg
+    # the report is regression-comparable against itself
+    doc = {e["metric"]: e for e in report["all"]}
+    assert any(m.startswith("Kernel max rel error") for m in doc)
+    text = kerneldiff.format_report(report)
+    assert "dot_product_attention" in text
+    kerneldiff.publish_metrics(report)
+    fam = get_registry().get("dl4j_kernel_max_rel_error")
+    assert fam is not None
+
+
+def test_committed_kernel_trust_snapshot_passes_rules():
+    """The committed kernel_trust.json satisfies KERNEL_TRUST_RULES
+    against itself — the regression sentinel's fixed point."""
+    import os
+    from deeplearning4j_tpu.observability import regression
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "kernel_trust.json")
+    with open(path) as f:
+        snap = json.load(f)
+    report = regression.compare(snap, snap,
+                                rules=regression.KERNEL_TRUST_RULES)
+    assert report.regressions == []
+    assert report.exit_code == 0
+    assert snap["summary"]["failing_configs"] == 0
+    assert snap["summary"]["untrusted"] == []
+    # satellite 1: the 18 flash-attention failures are triaged as
+    # harness/API drift, not kernel bugs
+    assert snap["triage"]["flash_attention_tests"]["kernel_bug_count"] == 0
+
+
+# ---------------------------------------------------------------- conf serde
+
+def test_policy_serde_roundtrip():
+    conf = (NeuralNetConfiguration.builder().seed(5)
+            .updater("adam", learning_rate=0.01)
+            .training_numerics(sample=512, interval=4,
+                               absorb_threshold=0.25)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=4, activation="relu"))
+            .layer(OutputLayer(n_in=4, n_out=2, loss="mcxent",
+                               activation="softmax"))
+            .build())
+    d = conf.to_dict()
+    back = type(conf).from_dict(d)
+    assert back.numerics == conf.numerics
+    assert back.numerics.sample == 512
+    assert back.numerics.interval == 4
+    assert back.numerics.absorb_threshold == 0.25
+    with pytest.raises(ValueError):
+        TrainingNumerics(sample=-1)
+    with pytest.raises(ValueError):
+        TrainingNumerics(interval=0)
+    with pytest.raises(ValueError):
+        TrainingNumerics(absorb_threshold=0.0)

@@ -340,11 +340,11 @@ def test_reserved_subtrees_mirror_state_keys():
     """shardstats' literal reserved-subtree names must track the real
     owners (the ledger stays importable without jax, so it cannot import
     them)."""
-    from deeplearning4j_tpu.observability import introspection
+    from deeplearning4j_tpu.observability import introspection, numerics
     from deeplearning4j_tpu.resilience import stability
 
     assert set(shardstats.RESERVED_REPLICATED_SUBTREES) == {
-        stability.STATE_KEY, introspection.STATE_KEY}
+        stability.STATE_KEY, introspection.STATE_KEY, numerics.STATE_KEY}
 
 
 def test_zero_shardable_predicate():
