@@ -630,7 +630,13 @@ def bench_generation(platform, peak):
     single-stream baseline (a dedicated slots=1 engine — the honest
     "one request at a time" arm, not a 16-lane engine running one lane).
     Also proves the decode-side AOT contract on record: steady-state
-    mixed traffic after warmup triggers zero XLA compiles."""
+    mixed traffic after warmup triggers zero XLA compiles.
+
+    The ``prefix_cache`` sub-entry measures the persistent radix-tree
+    cache: 90% of requests share a pinned system prefix (hit =
+    suffix-only prefill vs cold full-prompt prefill → p99 TTFT collapse),
+    a 4-turn pinned chat session, and a tight-pool spill drill that
+    round-trips KV pages through the host tier."""
     import threading
 
     from deeplearning4j_tpu.generation import GenerationEngine
@@ -649,14 +655,14 @@ def bench_generation(platform, peak):
         per_client, max_new = 3, 32
     vocab = 128
 
-    def build_engine(n_slots):
+    def build_engine(n_slots, *, max_context=ctx, buckets=(16,), **kw):
         net = transformer_char_lm(
             vocab_size=vocab, d_model=d_model, n_heads=heads,
-            layers=layers, max_cache=ctx,
+            layers=layers, max_cache=max_context,
             compute_dtype="bfloat16" if platform == "tpu" else None)
         eng = GenerationEngine(
-            net, slots=n_slots, page_size=page, max_context=ctx,
-            max_queue=4096, deadline_s=600.0, prefill_buckets=(16,))
+            net, slots=n_slots, page_size=page, max_context=max_context,
+            max_queue=4096, deadline_s=600.0, prefill_buckets=buckets, **kw)
         return eng.start()
 
     def drive(eng, n_clients):
@@ -720,6 +726,75 @@ def bench_generation(platform, peak):
     stats = engine.stats()["scheduler"]["cache"]
     engine.stop()
     c16 = arms["clients_16"]
+
+    # ---- persistent prefix-cache arm (radix-tree cross-request reuse) --
+    # 90% of requests share a page-aligned system prefix (512 tokens on
+    # TPU; the CPU tier scales it down like every other config here).  On
+    # a hit only the suffix prefills (bucket 16); a cold miss prefills
+    # the whole prompt — the TTFT collapse the persistent cache buys.
+    # The shared prefix is pinned so churn cannot evict it.
+    if platform == "tpu":
+        prefix_len, cold_bucket, p_ctx = 512, 576, 640
+    else:
+        prefix_len, cold_bucket, p_ctx = 192, 256, 288
+    p_max_new = 24
+    peng = build_engine(slots, max_context=max(p_ctx, ctx),
+                        buckets=(16, cold_bucket), prefix_cache=True)
+    rs = np.random.RandomState(4242)
+    sys_prefix = rs.randint(0, vocab, prefix_len).tolist()
+
+    def prefix_prompt(hit):
+        tail = rs.randint(0, vocab, 4 + rs.randint(9)).tolist()
+        return (sys_prefix + tail if hit
+                else rs.randint(0, vocab, prefix_len).tolist() + tail)
+
+    peng.submit(prefix_prompt(True), p_max_new).result(timeout=600)
+    pin_id = peng.pin_prefix(sys_prefix)
+    pmv = peng.models.active("default")
+    p_compiles0 = pmv.detector.compile_count
+    hit_t, miss_t, p_tokens = [], [], 0
+    t0 = time.perf_counter()
+    for i in range(40):
+        h = peng.submit(prefix_prompt(i % 10 != 9), p_max_new)
+        p_tokens += len(h.result(timeout=600))
+        (hit_t if h.shared_len > 0 else miss_t).append(h.ttft_s)
+    p_wall = time.perf_counter() - t0
+    p99_hit = float(np.percentile(hit_t, 99)) * 1e3
+    p99_miss = float(np.percentile(miss_t, 99)) * 1e3
+
+    # multi-turn chat: each turn pins the grown history so the next turn
+    # only prefills the newly appended tokens
+    chat, history = [], list(sys_prefix)
+    pin = peng.pin_prefix(history)
+    for turn in range(4):
+        h = peng.submit(history, 8)
+        toks = h.result(timeout=600)
+        chat.append({"turn": turn + 1, "prompt_tokens": len(history),
+                     "shared_tokens": h.shared_len,
+                     "ttft_ms": round(h.ttft_s * 1e3, 3)})
+        history = history + list(map(int, toks)) \
+            + rs.randint(0, vocab, 2).tolist()
+        fresh_pin = peng.pin_prefix(history)
+        peng.unpin_prefix(pin)
+        pin = fresh_pin
+    peng.unpin_prefix(pin)
+    peng.unpin_prefix(pin_id)
+    p_steady_compiles = pmv.detector.compile_count - p_compiles0
+    pstats = peng.prefix_cache.stats()
+    peng.stop()
+
+    # tight-pool spill drill: a 2-slot engine whose tree cannot stay
+    # resident, so revisits round-trip KV pages through the host tier
+    tiny = transformer_char_lm(vocab_size=vocab, d_model=32, n_heads=4,
+                               layers=2, max_cache=32)
+    teng = GenerationEngine(tiny, slots=2, page_size=4, max_context=32,
+                            num_pages=13, prefix_cache=True).start()
+    rs2 = np.random.RandomState(77)
+    spill = [rs2.randint(0, vocab, 9).tolist() for _ in range(6)]
+    for p in spill + spill:
+        teng.submit(p, 8).result(timeout=600)
+    tstats = teng.prefix_cache.stats()
+    teng.stop()
     return {
         "metric": (f"Generation tokens/sec (continuous batching, "
                    f"d{d_model} L{layers}, {slots} slots, page {page}, "
@@ -737,6 +812,26 @@ def bench_generation(platform, peak):
         "steady_state_compiles": steady_compiles,
         "prefix_shared_pages": stats["shared_pages_total"],
         "arms": arms,
+        "prefix_cache": {
+            "tokens_per_sec": round(p_tokens / p_wall, 1),
+            "p99_ttft_hit_ms": round(p99_hit, 3),
+            "p99_ttft_miss_ms": round(p99_miss, 3),
+            "hit_requests": len(hit_t),
+            "miss_requests": len(miss_t),
+            "hit_rate": round(pstats["hit_rate"], 4),
+            "hits": pstats["hits"],
+            "misses": pstats["misses"],
+            # sentinels (ints: the regression checker skips bools) — a
+            # hit must cost <= 0.3x a cold miss at p99, and the steady
+            # state must actually be hitting
+            "ttft_collapse_ok": int(p99_hit <= 0.3 * p99_miss),
+            "hit_rate_nonzero": int(pstats["hits"] > 0),
+            "steady_state_compiles": p_steady_compiles,
+            "chat_turns": chat,
+            "spill_offload_total": tstats["offload_total"],
+            "spill_restore_total": tstats["restore_total"],
+            "spill_host_pages": tstats["host_pages"],
+        },
     }
 
 

@@ -4,9 +4,11 @@ The decode analog of ``deeplearning4j_tpu/serving/``: requests join and
 leave a RUNNING decode batch at every step (iteration-level scheduling,
 Orca/vLLM), KV state lives in fixed-size pages addressed through int32
 block tables (closed XLA shape set, zero steady-state recompiles),
-identical prompt prefixes share refcounted pages, and the serving model
-hot-swaps between decode steps with zero dropped streams.  See
-docs/serving.md ("Generation").
+identical prompt prefixes share refcounted pages, the optional
+persistent radix-tree prefix cache keeps prompt pages ALIVE across
+requests (pinning, host-tier offload, cache-aware admission — see
+``prefix_cache.py``), and the serving model hot-swaps between decode
+steps with zero dropped streams.  See docs/serving.md ("Generation").
 """
 
 from deeplearning4j_tpu.generation.engine import (      # noqa: F401
@@ -14,6 +16,9 @@ from deeplearning4j_tpu.generation.engine import (      # noqa: F401
 )
 from deeplearning4j_tpu.generation.paged_cache import (  # noqa: F401
     PagedKVCache, PageExhaustedError,
+)
+from deeplearning4j_tpu.generation.prefix_cache import (  # noqa: F401
+    PrefixCache, PrefixCacheConfig, StalePrefixError,
 )
 from deeplearning4j_tpu.generation.programs import (     # noqa: F401
     GenerationPrograms, seed_paged_pools,

@@ -42,6 +42,9 @@ import jax
 import numpy as np
 
 from deeplearning4j_tpu.generation.paged_cache import PagedKVCache
+from deeplearning4j_tpu.generation.prefix_cache import (
+    PrefixCache, PrefixCacheConfig,
+)
 from deeplearning4j_tpu.generation.programs import GenerationPrograms
 from deeplearning4j_tpu.generation.scheduler import (
     DecodeScheduler, GenerationRequest,
@@ -71,7 +74,8 @@ class GenerationEngine:
                  max_queue: int = 64, deadline_s: float = 60.0,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  models: Optional[ModelRegistry] = None, registry=None,
-                 default_model: str = DEFAULT_MODEL):
+                 default_model: str = DEFAULT_MODEL,
+                 prefix_cache=None):
         if max_context < 2:
             raise ValueError(f"max_context={max_context} must be >= 2")
         pages_per_slot = -(-int(max_context) // int(page_size))
@@ -84,6 +88,18 @@ class GenerationEngine:
             metrics_registry=self.metrics.registry)
         self.default_model = default_model
         self.cache = PagedKVCache(num_pages, page_size, pages_per_slot)
+        # persistent radix-tree prefix cache (opt-in retention policy):
+        # prefix_cache=True for defaults, a PrefixCacheConfig for knobs,
+        # None/False keeps PR-13 free-on-release behavior bit-identical
+        self.prefix_cache: Optional[PrefixCache] = None
+        if prefix_cache:
+            cfg = (prefix_cache if isinstance(prefix_cache,
+                                              PrefixCacheConfig)
+                   else PrefixCacheConfig())
+            self.prefix_cache = PrefixCache(
+                self.cache, host_budget_bytes=cfg.host_budget_bytes,
+                metrics=self.metrics)
+            self.cache.retention = self.prefix_cache
         self.scheduler = DecodeScheduler(
             self.cache, slots=slots, max_queue=max_queue,
             default_deadline_s=deadline_s, metrics=self.metrics)
@@ -113,6 +129,14 @@ class GenerationEngine:
         mv = self.models.active(self.default_model)
         progs = self._build_programs(mv)
         self._pools = progs.fresh_pools()
+        if self.prefix_cache is not None:
+            # fresh pools mean every cached node points at garbage:
+            # drop the tree, stamp the serving version, wire the page
+            # transport + host-budget unit
+            self.prefix_cache.invalidate("pool_reset")
+            self.prefix_cache.set_version(mv.key)
+            self.prefix_cache.attach(self,
+                                     progs.page_nbytes(self._pools))
         self.scheduler.reopen()   # a restart re-arms admission
         self._stop_event.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -160,7 +184,7 @@ class GenerationEngine:
             prompt, max_new_tokens, temperature=temperature, top_k=top_k,
             top_p=top_p, seed=seed, deadline_s=deadline,
             stop_token=stop_token, trace_id=trace_id)
-        # worst case (no prefix shared) the WHOLE prompt prefises in one
+        # worst case (no prefix shared) the WHOLE prompt prefills in one
         # bucket; reject here with a clean error instead of detonating a
         # ValueError on the decode thread mid-batch
         if len(req.prompt) > max(self.prefill_buckets):
@@ -175,6 +199,38 @@ class GenerationEngine:
         as a 1-D array."""
         req = self.submit(prompt, max_new_tokens, **kw)
         return np.asarray(req.result(), np.int32)
+
+    # -------------------------------------------------------- prefix pinning
+    def pin_prefix(self, prompt: Sequence[int]) -> int:
+        """Pin ``prompt``'s cached prefix pages against offload and
+        eviction (multi-turn sessions pin their history after each turn
+        so the next turn only prefills the new tokens); returns a pin id
+        for ``unpin_prefix``.  Thread-safe."""
+        if self.prefix_cache is None:
+            raise RuntimeError(
+                "pin_prefix requires the persistent prefix cache "
+                "(GenerationEngine(..., prefix_cache=True))")
+        return self.prefix_cache.pin(prompt)
+
+    def unpin_prefix(self, pin_id: int) -> None:
+        """Release one pin; an unknown or already-released id raises
+        ``KeyError``."""
+        if self.prefix_cache is None:
+            raise RuntimeError(
+                "unpin_prefix requires the persistent prefix cache")
+        self.prefix_cache.unpin(pin_id)
+
+    # ------------------------------------------------- prefix-cache transport
+    # PrefixCache calls these on the decode thread (inside admission,
+    # which the engine's single decode loop drives), so reading and
+    # replacing self._pools here is the owner thread acting.
+    def cache_read_page(self, page: int):
+        progs = self._programs[self.models.active(self.default_model).key]
+        return progs.read_page(self._pools, page)
+
+    def cache_write_page(self, page: int, payload) -> None:
+        progs = self._programs[self.models.active(self.default_model).key]
+        self._pools = progs.write_page(self._pools, page, payload)
 
     # ----------------------------------------------------------- model admin
     def deploy(self, name: str, model, *, retain_old: bool = False,
@@ -286,6 +342,17 @@ class GenerationEngine:
             try:
                 with self.models.lease(self.default_model) as mv:
                     progs = self._programs[mv.key]
+                    if (self.prefix_cache is not None
+                            and self.prefix_cache.version != mv.key):
+                        # hot-swap/rollback observed: cached KV was
+                        # prefilled under the displaced weights — a
+                        # stale hit would be silently wrong, so the
+                        # whole tree goes before any admission runs
+                        n = self.prefix_cache.invalidate("swap")
+                        self.prefix_cache.set_version(mv.key)
+                        logger.info("prefix cache invalidated on swap "
+                                    "to %s (%d nodes dropped)",
+                                    mv.key, n)
                     self._admit(progs, mv)
                     if self.scheduler.active_slots():
                         self._step(progs, mv)
@@ -300,6 +367,9 @@ class GenerationEngine:
                     self._pools = self._programs[
                         self.models.active(self.default_model).key
                     ].fresh_pools()
+                    if self.prefix_cache is not None:
+                        # the reseed just zeroed every cached page
+                        self.prefix_cache.invalidate("pool_reset")
                 except Exception:
                     logger.exception("pool reseed failed; decode thread "
                                      "exiting")
@@ -370,6 +440,11 @@ class GenerationEngine:
     def _refresh_gauges(self) -> None:
         self.metrics.active_slots.set(len(self.scheduler.active_slots()))
         self.metrics.page_util.set(self.cache.utilization())
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache
+            self.metrics.prefix_cache_resident.set(pc.resident_pages())
+            self.metrics.prefix_cache_pinned.set(pc.pinned_pages())
+            self.metrics.prefix_cache_host_bytes.set(pc.host_bytes)
 
     def _on_finish(self, req: GenerationRequest) -> None:
         """Terminal accounting for every request, whatever path ended it
@@ -411,6 +486,16 @@ class GenerationEngine:
             "prefill_buckets": list(self.prefill_buckets),
             "decode_thread_alive": (self._thread is not None
                                     and self._thread.is_alive()),
+        }
+
+    def cache_stats(self) -> dict:
+        """The ``GET /generation/cache`` payload: allocator occupancy
+        plus the persistent prefix cache's tree/host-tier stats (null
+        when running the legacy free-on-release policy)."""
+        return {
+            "cache": self.cache.as_dict(),
+            "prefix_cache": (self.prefix_cache.stats()
+                             if self.prefix_cache is not None else None),
         }
 
 

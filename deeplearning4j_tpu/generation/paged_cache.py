@@ -79,6 +79,12 @@ class PagedKVCache:
         # counters the engine mirrors into metrics
         self.shared_pages = 0
         self.fresh_pages = 0
+        # pluggable retention policy (generation.prefix_cache.PrefixCache):
+        # when set, admission routes through its radix tree and completed
+        # requests' prompt pages stay cached under the tree's own refs
+        # instead of returning to the free list.  None (the default)
+        # keeps the legacy free-on-release behavior bit-identical.
+        self.retention = None
 
     # ------------------------------------------------------------ capacity
     @property
@@ -170,6 +176,26 @@ class PagedKVCache:
     def _full_prompt_pages(self, prompt: Sequence[int]) -> range:
         return range(len(prompt) // self.page_size)
 
+    def alloc(self, count: int) -> List[int]:
+        """Raw allocation of ``count`` pages at refcount 1 (retention
+        policies use this for restore targets; ``admit`` stays the
+        request-shaped entry point)."""
+        if count > len(self._free):
+            raise PageExhaustedError(
+                f"need {count} pages, {len(self._free)} free "
+                f"(pool {self.num_pages - 1})")
+        pages = [self._free.pop() for _ in range(count)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
+
+    def ref(self, page: int) -> None:
+        """Take one additional reference on an already-allocated page."""
+        if page == TRASH_PAGE or self._refs[page] < 1:
+            raise AssertionError(
+                f"ref on unallocated page {page} (refs={self._refs[page]})")
+        self._refs[page] += 1
+
     def free(self, pages: Sequence[int]) -> None:
         """Drop one request's references; pages return to the free list
         (and leave the prefix index) when their last sharer leaves."""
@@ -196,11 +222,14 @@ class PagedKVCache:
         return row
 
     def as_dict(self) -> dict:
-        return {"num_pages": self.num_pages, "page_size": self.page_size,
-                "pages_per_slot": self.pages_per_slot,
-                "free_pages": self.free_pages,
-                "used_pages": self.used_pages,
-                "utilization": round(self.utilization(), 4),
-                "prefix_index_size": len(self._prefix),
-                "shared_pages_total": self.shared_pages,
-                "fresh_pages_total": self.fresh_pages}
+        out = {"num_pages": self.num_pages, "page_size": self.page_size,
+               "pages_per_slot": self.pages_per_slot,
+               "free_pages": self.free_pages,
+               "used_pages": self.used_pages,
+               "utilization": round(self.utilization(), 4),
+               "prefix_index_size": len(self._prefix),
+               "shared_pages_total": self.shared_pages,
+               "fresh_pages_total": self.fresh_pages}
+        if self.retention is not None:
+            out["prefix_cache"] = self.retention.stats()
+        return out

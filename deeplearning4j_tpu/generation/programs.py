@@ -1,6 +1,6 @@
 """Compiled generation programs: bucketed prefill + ONE decode step.
 
-The whole engine dispatches exactly ``len(prefill_buckets) + 1`` XLA
+The whole engine dispatches exactly ``len(prefill_buckets) + 3`` XLA
 programs per model version, all AOT-warmed before the version serves:
 
 - ``prefill_<bucket>``: one request's (non-shared) prompt suffix, padded
@@ -11,6 +11,8 @@ programs per model version, all AOT-warmed before the version serves:
   the iteration-level batch.  Idle slots ride along pointed at the
   trash page with temperature 0; their lanes are pure garbage-in/
   garbage-out and the scheduler ignores their outputs.
+- ``read_page`` / ``write_page``: one page's K/V slice out of / into
+  every pool — the prefix cache's host-tier transport.
 
 Shapes are closed by construction (slot count, pool size, block-table
 width, bucket lengths are all fixed at engine construction), so steady
@@ -128,6 +130,12 @@ class GenerationPrograms:
         self._prefill = {
             b: jax.jit(self._make_prefill(b), donate_argnums=(2,))
             for b in self.prefill_buckets}
+        # page transport (prefix-cache host tier): one page's K/V slice
+        # out of / into every pool.  Fixed shapes — two more members of
+        # the closed program set, warmed with the rest.
+        self._read_page = jax.jit(self._make_read_page())
+        self._write_page = jax.jit(self._make_write_page(),
+                                   donate_argnums=(0,))
 
     # ---------------------------------------------------------------- build
     def fresh_pools(self):
@@ -184,6 +192,42 @@ class GenerationPrograms:
 
         return prefill
 
+    def _make_read_page(self):
+        def read_page(pools, page):
+            """One page's [page_size, Hkv, D] K/V slice from every pool
+            (the offload side of the host tier)."""
+            def walk(c):
+                if isinstance(c, dict) and "pk" in c:
+                    return {"pk": jax.lax.dynamic_index_in_dim(
+                                c["pk"], page, 0, keepdims=False),
+                            "pv": jax.lax.dynamic_index_in_dim(
+                                c["pv"], page, 0, keepdims=False)}
+                if isinstance(c, dict):
+                    return {k: walk(v) for k, v in c.items()}
+                return c
+            return {k: walk(v) for k, v in pools.items()}
+
+        return read_page
+
+    def _make_write_page(self):
+        def write_page(pools, page, payload):
+            """One page's K/V slice back into every pool (the restore
+            side); pools are donated, so the write is in place."""
+            def walk(c, p):
+                if isinstance(c, dict) and "pk" in c:
+                    return {"pk": jax.lax.dynamic_update_index_in_dim(
+                                c["pk"], p["pk"].astype(c["pk"].dtype),
+                                page, 0),
+                            "pv": jax.lax.dynamic_update_index_in_dim(
+                                c["pv"], p["pv"].astype(c["pv"].dtype),
+                                page, 0)}
+                if isinstance(c, dict):
+                    return {k: walk(v, p[k]) for k, v in c.items()}
+                return c
+            return {k: walk(v, payload[k]) for k, v in pools.items()}
+
+        return write_page
+
     # ------------------------------------------------------------- dispatch
     def decode(self, params, net_state, pools, block, pos, tokens, keys,
                token_idx, temps, top_ks, top_ps, expected: bool = False):
@@ -202,6 +246,35 @@ class GenerationPrograms:
         return self._prefill[bucket](
             params, net_state, pools, block, start, last_idx, tokens,
             keys, token_idx, temps, top_ks, top_ps)
+
+    def read_page(self, pools, page: int, expected: bool = False):
+        """Device → host: one page's K/V slices as a numpy payload."""
+        if self.detector is not None:
+            self.detector.check(("read_page",), {}, expected=expected)
+        return jax.device_get(self._read_page(pools, np.int32(page)))
+
+    def write_page(self, pools, page: int, payload,
+                   expected: bool = False):
+        """Host → device: write a payload into page ``page``; returns
+        the new pools (the old ones are donated/consumed)."""
+        if self.detector is not None:
+            self.detector.check(("write_page",), {}, expected=expected)
+        return self._write_page(pools, np.int32(page), payload)
+
+    def page_nbytes(self, pools) -> int:
+        """Host bytes one offloaded page costs (every pool's K+V slice)
+        — the unit of the prefix cache's host-tier budget."""
+        total = 0
+        def walk(c):
+            nonlocal total
+            if isinstance(c, dict) and "pk" in c:
+                total += ((c["pk"].nbytes + c["pv"].nbytes)
+                          // c["pk"].shape[0])
+            elif isinstance(c, dict):
+                for v in c.values():
+                    walk(v)
+        walk(pools)
+        return total
 
     # --------------------------------------------------------------- warmup
     def warm(self) -> int:
@@ -264,6 +337,8 @@ class GenerationPrograms:
             zeros_i((s,), np.int32), zeros_i((s,), np.float32),
             zeros_i((s,), np.int32), np.ones((s,), np.float32),
             expected=True)
+        payload = self.read_page(pools, 1, expected=True)
+        pools = self.write_page(pools, 1, payload, expected=True)
         jax.block_until_ready(tok)
         del pools
-        return len(self.prefill_buckets) + 1
+        return len(self.prefill_buckets) + 3

@@ -83,6 +83,7 @@ class GenerationRequest:
         self.slot: Optional[int] = None
         self.pages: List[int] = []
         self.shared_len = 0
+        self.cache_admit = None   # AdmitResult when retention is active
 
     # ----------------------------------------------------------- client API
     def cancel(self) -> None:
@@ -278,10 +279,17 @@ class DecodeScheduler:
 
     def next_admittable(self) -> Optional[GenerationRequest]:
         """Pop the oldest pending request IF a slot is free and its page
-        budget fits (allocates pages + a slot; the caller prefises it
+        budget fits (allocates pages + a slot; the caller prefills it
         immediately).  FIFO: a head request that doesn't fit blocks
         later ones — admission order is completion-order fairness, not
-        best-fit packing."""
+        best-fit packing.
+
+        With a retention policy installed, admission is cache-aware: the
+        radix tree prices the request at ⌈suffix/page⌉ instead of
+        ⌈prompt/page⌉ on a hit, refs the matched pages before anything
+        can evict them, and may evict/offload cold unpinned tree nodes
+        to make room — ``PageExhaustedError`` then means even eviction
+        could not free enough."""
         free = next((i for i, s in enumerate(self.slots) if s is None), None)
         if free is None:
             return None
@@ -289,17 +297,25 @@ class DecodeScheduler:
             if not self._pending:
                 return None
             req = self._pending[0]
+            admit_result = None
             try:
                 # never-fits requests were rejected at submit(), so the
                 # only failure here is transient pool pressure
-                pages, shared_len = self.cache.admit(req.prompt,
-                                                     req.max_new_tokens)
+                if self.cache.retention is not None:
+                    admit_result = self.cache.retention.admit(
+                        req.prompt, req.max_new_tokens)
+                    pages = admit_result.pages
+                    shared_len = admit_result.shared_len
+                else:
+                    pages, shared_len = self.cache.admit(req.prompt,
+                                                         req.max_new_tokens)
             except PageExhaustedError:
                 return None     # keep queued; pages free as slots retire
             self._pending.popleft()
         req.slot = free
         req.pages = pages
         req.shared_len = shared_len
+        req.cache_admit = admit_result
         return req
 
     def fail_admitted(self, req: GenerationRequest,
@@ -310,6 +326,12 @@ class DecodeScheduler:
         its never-written pages) and release the waiters — without this
         the request is invisible to ``evict_all`` and would hang its
         clients forever while leaking its pages."""
+        if req.cache_admit is not None:
+            # radix nodes this admission created were never prefilled;
+            # drop them (and the tree's refs) before the request's own
+            # refs go, or a later match would serve unwritten pages
+            self.cache.retention.forget(req.cache_admit)
+            req.cache_admit = None
         self.cache.free(req.pages)
         req.pages = []
         req.slot = None

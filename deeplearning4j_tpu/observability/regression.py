@@ -101,6 +101,20 @@ DEFAULT_RULES: List[Rule] = [
          tolerance=1.0, required=False),
     Rule("Generation tokens/sec", field="steady_state_compiles",
          direction=LOWER, tolerance=0.0, required=False),
+    # persistent prefix cache (ISSUE 17): ttft_collapse_ok pins "a hit's
+    # p99 TTFT is <= 0.3x a cold miss's" (1 = collapse held; direction=
+    # higher + tolerance=0 means any drop to 0 regresses), and
+    # hit_rate_nonzero pins "the steady state actually hits the cache" —
+    # a change that silently stops matching (version-tag bug, tree never
+    # populated) fails immediately rather than showing up as a slow
+    # TTFT drift
+    Rule("Generation tokens/sec", field="prefix_cache.ttft_collapse_ok",
+         tolerance=0.0, required=False),
+    Rule("Generation tokens/sec", field="prefix_cache.hit_rate_nonzero",
+         tolerance=0.0, required=False),
+    Rule("Generation tokens/sec",
+         field="prefix_cache.steady_state_compiles",
+         direction=LOWER, tolerance=0.0, required=False),
     Rule("Long-context train tokens/sec", tolerance=0.4),
     Rule("Serving rows/sec", tolerance=0.4),
     Rule("Serving rows/sec", field="p99_ms", direction=LOWER, tolerance=1.0,

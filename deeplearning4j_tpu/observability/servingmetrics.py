@@ -119,10 +119,35 @@ class GenerationMetrics:
             "Decode-step dispatches (one per running-batch iteration)")
         self.prefix_pages = reg.counter(
             "dl4j_decode_prefix_pages_total",
-            "Prompt pages at admission, by whether an identical in-flight "
-            "prefix let them be shared (refcounted) instead of prefilled "
-            "fresh — shared/(shared+fresh) is the prefix-share hit rate",
+            "Prompt pages at admission by outcome: shared counts pages an "
+            "identical prefix let the request reference instead of "
+            "prefilling fresh — BOTH in-flight sharing (another running "
+            "request owns the page) and persistent prefix-cache hits "
+            "(the radix tree kept it alive past its last request) land "
+            "here; dl4j_prefix_cache_* tells the two apart",
             labels=("outcome",))
+        # persistent radix-tree prefix cache (generation/prefix_cache.py)
+        self.prefix_cache_hits = reg.counter(
+            "dl4j_prefix_cache_hits",
+            "Admissions whose prompt matched >= 1 cached radix-tree page "
+            "(prefill priced at the suffix instead of the whole prompt)")
+        self.prefix_cache_misses = reg.counter(
+            "dl4j_prefix_cache_misses",
+            "Admissions that matched nothing in the radix tree")
+        self.prefix_cache_offloads = reg.counter(
+            "dl4j_prefix_cache_offload_total",
+            "Cold cached pages spilled device -> host tier (page slice "
+            "copied out, device page freed, prefix still cached)")
+        self.prefix_cache_restores = reg.counter(
+            "dl4j_prefix_cache_restore_total",
+            "Host-tier pages restored into fresh device pages on a hit")
+        self.prefix_cache_evictions = reg.counter(
+            "dl4j_prefix_cache_evictions_total",
+            "Radix-tree nodes dropped outright, by reason (capacity = "
+            "device room with no host budget left, host_capacity = host "
+            "tier over budget, swap = weights changed, pool_reset = "
+            "pools reseeded, abort = admission's prefill failed)",
+            labels=("reason",))
         self.ttft = reg.histogram(
             "dl4j_decode_ttft_seconds",
             "Time to first token: submit -> first sampled token delivered "
@@ -148,6 +173,18 @@ class GenerationMetrics:
             "dl4j_decode_page_utilization",
             "Allocated fraction of the paged KV pool (trash page "
             "excluded)", labels=("engine",)).labels(engine=self.engine_id)
+        self.prefix_cache_resident = reg.gauge(
+            "dl4j_prefix_cache_resident_pages",
+            "Device pages the prefix-cache radix tree currently keeps "
+            "alive", labels=("engine",)).labels(engine=self.engine_id)
+        self.prefix_cache_pinned = reg.gauge(
+            "dl4j_prefix_cache_pinned_pages",
+            "Cached pages protected by at least one session pin",
+            labels=("engine",)).labels(engine=self.engine_id)
+        self.prefix_cache_host_bytes = reg.gauge(
+            "dl4j_prefix_cache_host_tier_bytes",
+            "Bytes of offloaded KV page payloads held in the host-RAM "
+            "tier", labels=("engine",)).labels(engine=self.engine_id)
         self.batch_occupancy = reg.histogram(
             "dl4j_decode_batch_occupancy",
             "Active slots per dispatched decode step / total slots (1.0 = "

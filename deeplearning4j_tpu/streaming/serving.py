@@ -56,6 +56,11 @@ class InferenceServer:
       (``observability.health.HealthRule``).
     - ``GET /metrics`` — Prometheus scrape of the metrics registry.
     - ``GET /models`` — engine/model-registry state (versions, queue).
+    - ``GET /generation/cache`` (when a ``generation=`` engine is
+      wired) — paged-pool occupancy plus the persistent prefix cache's
+      stats: hit rate, resident/pinned pages, host-tier bytes,
+      offload/restore/eviction counters (``null`` under the legacy
+      free-on-release policy); 404 without a generation engine.
     - ``POST /models/<name>`` — hot-swap: body ``{"path": <checkpoint>}``
       loads a ``models/serialization.py`` zip, warms every bucket shape,
       and atomically swaps it in with zero dropped requests.
@@ -246,6 +251,15 @@ class InferenceServer:
                     self.wfile.write(body)
                 elif self.path == "/models":
                     self._json(server.engine.stats())
+                elif self.path == "/generation/cache":
+                    # paged-pool occupancy + persistent prefix-cache
+                    # stats (hit rate, resident/pinned pages, host tier)
+                    if server.generation is None:
+                        self._json({"error": "this server has no "
+                                    "generation engine", "type":
+                                    "ModelNotFoundError"}, code=404)
+                    else:
+                        self._json(server.generation.cache_stats())
                 else:
                     self.send_error(404)
 

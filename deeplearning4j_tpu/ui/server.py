@@ -160,12 +160,16 @@ class UIServer:
       collective accounting when a ``ShardStatsCollector`` is installed,
       and the PJRT device stats (docs/observability.md "Memory &
       communication").
+    - ``GET /generation/cache`` — paged-pool occupancy + persistent
+      prefix-cache stats of an attached ``GenerationEngine``
+      (``attach_generation``); 404 until one is attached.
     """
 
     def __init__(self, storage: Optional[StatsStorage] = None, port: int = 0,
                  registry=None, health: Optional[HealthEvaluator] = None):
         self.storage = storage or InMemoryStatsStorage()
         self._registry = registry
+        self.generation = None   # attach_generation()
         self.health = health or HealthEvaluator(
             default_training_rules(), component="training",
             registry=registry)
@@ -175,6 +179,11 @@ class UIServer:
         # set on stop(): live SSE handler threads poll it between
         # heartbeats so shutdown never waits on an open stream
         self._stopping = threading.Event()
+
+    def attach_generation(self, engine) -> None:
+        """Expose a ``GenerationEngine``'s cache stats on
+        ``GET /generation/cache`` (the serving-side twin of /memory)."""
+        self.generation = engine
 
     # ------------------------------------------------------------- queries
     def compare_sessions(self, sids: List[str],
@@ -465,6 +474,16 @@ class UIServer:
                                      else {}),
                         "device_memory": device_memory_stats(),
                     })
+                elif path == "/generation/cache":
+                    # a serving-side panel in the training UI: the
+                    # attached generation engine's paged-pool occupancy
+                    # + persistent prefix-cache stats
+                    if ui.generation is None:
+                        self._json({"error": "no generation engine "
+                                    "attached (UIServer."
+                                    "attach_generation)"}, 404)
+                    else:
+                        self._json(ui.generation.cache_stats())
                 elif path == "/health":
                     verdict = ui.health.evaluate()
                     self._json(verdict.to_dict(),

@@ -100,6 +100,13 @@ def pytest_configure(config):
         "precision ledger (dynamic-range stats, format-safety verdicts, "
         "spike drill), KV-page range stats, and the kernel-trust "
         "differential harness (python -m pytest -m numerics)")
+    config.addinivalue_line(
+        "markers",
+        "prefix_cache: persistent radix-tree prefix-cache tests — "
+        "cross-request KV reuse, pinning, host-tier offload/restore "
+        "round-trips, cache-aware admission, invalidation-on-swap, and "
+        "the seeded cache-invariant fuzzer "
+        "(python -m pytest -m prefix_cache)")
 
 
 def pytest_collection_modifyitems(config, items):

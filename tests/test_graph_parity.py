@@ -76,7 +76,11 @@ def test_graph_tbptt_trains_and_carries():
     """Window < T: multiple windows per batch, state carried, loss drops."""
     rs = np.random.RandomState(8)
     x, y = _seq_data(rs, b=4, t=12)
-    net = _lstm_graph(tbptt=4, seed=5, lr=0.1)
+    # lr=0.05: at 0.1 plain SGD on this 4-unit LSTM oscillates around the
+    # optimum (4.37 -> 4.27 -> 4.43 over the 31 fits) so the "loss drops"
+    # assertion is a coin flip; the TBPTT math itself is pinned by the
+    # window==T equivalence test above.
+    net = _lstm_graph(tbptt=4, seed=5, lr=0.05)
     net.fit(x, y)
     first = net.score_value
     # 3 windows of 4 -> 3 optimizer steps for one batch
