@@ -185,18 +185,28 @@ class PrefixCache:
             # prompt token is left to prefill (its logits seed sampling)
             matched = self._match(prompt, (len(prompt) - 1) // self.page_size)
             # refs FIRST: a matched resident page must be un-evictable
-            # before any room-making below can consider it
+            # before any room-making below can consider it.  A ref only
+            # exists for RESIDENT matches — a host-tier match has no
+            # page yet — so every matched node also takes a temporary
+            # admission pin: pins exclude a node from _make_room's
+            # victim set AND from _drop_host_leaf, which could otherwise
+            # drop a cold matched host node (detaching it from the tree
+            # and nulling the payload the restore loop is about to
+            # write back).
             for n in matched:
                 if n.page is not None:
                     self.cache.ref(n.page)
+                n.pins += 1
             to_restore = [n for n in matched if n.page is None]
             fresh_count = total - len(matched)
+            offload_before = self.offload_total
             try:
                 self._make_room(fresh_count + len(to_restore))
             except PageExhaustedError:
-                for n in matched:       # unwind: request refs only — the
-                    if n.page is not None:   # tree's own ref stays
-                        self.cache.free([n.page])
+                for n in matched:       # unwind: request refs and the
+                    if n.page is not None:   # admission pins — the
+                        self.cache.free([n.page])  # tree's ref stays
+                    n.pins -= 1
                 raise
             # restore offloaded hits into fresh device pages (payload
             # written through the transport NOW — admit runs on the
@@ -211,6 +221,9 @@ class PrefixCache:
                 self.restore_total += 1
                 if self.metrics is not None:
                     self.metrics.prefix_cache_restores.inc()
+            for n in matched:   # restore done: every matched node is
+                n.pins -= 1     # resident + request-ref'd, so the
+                                # admission pins have done their job
             fresh = self.cache.alloc(fresh_count)
             pages = [n.page for n in matched] + fresh
             # register this request's freshly prefilled full prompt
@@ -250,7 +263,7 @@ class PrefixCache:
                  else self.metrics.prefix_cache_misses).inc()
             return AdmitResult(pages, len(matched) * self.page_size,
                                created, len(to_restore),
-                               0)
+                               self.offload_total - offload_before)
 
     def _match(self, prompt: List[int], max_pages: int) -> List[_Node]:
         # private helpers re-take the RLock their public callers already

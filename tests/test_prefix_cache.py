@@ -225,6 +225,46 @@ def test_offload_restore_round_trip_unit():
     cache.free(res_a.pages)
 
 
+def test_matched_host_node_protected_during_room_making():
+    """A host-tier hit has no device page to ref when admission starts,
+    so the admission pins must keep room-making off it: without them
+    `_host_has_room`'s drop pass picks the very node the restore loop
+    is about to write back (it is childless, unpinned, and the coldest
+    host leaf), detaching it from the tree mid-admission and nulling
+    its payload."""
+    # host budget of exactly ONE page: any further offload must first
+    # drop a host leaf — and the only host leaf is the matched node
+    cache, pc, tp = _mk(num_pages=6, page_size=4, pages_per_slot=8,
+                        budget=128)
+    assert tp.page_bytes() == 128
+    a = list(range(9))
+    res = pc.admit(a, 8)               # 4 pages, 2 become tree nodes
+    _stamp_fresh(pc, tp, a, res)
+    cache.free(res.pages)
+    # b's admission victimizes a's coldest node -> offloaded to host
+    b = [20 + i for i in range(9)]
+    res_b = pc.admit(b, 8)
+    _stamp_fresh(pc, tp, b, res_b)
+    cache.free(res_b.pages)
+    assert pc.host_pages() == 1 and res_b.offloaded_pages == 1
+    # hitting `a` matches one resident + one HOST node and still needs
+    # room; the full host tier must find its victims elsewhere
+    res_a = pc.admit(a, 8)
+    assert res_a.shared_len == 8 and res_a.restored_pages == 1
+    for i in range(2):
+        assert tp.read_stamp(res_a.pages[i]) == _chain_stamp(a, i)
+    # the matched host node was never dropped — a cold resident b-node
+    # was dropped outright instead (host tier full, budget 1 page)
+    assert pc.evictions.get("host_capacity", 0) == 0
+    assert pc.evictions.get("capacity", 0) == 1
+    # restore emptied the tier; a mid-admission drop of the matched
+    # node would have decremented host_bytes twice (negative bytes)
+    assert pc.host_pages() == 0 and pc.host_bytes == 0
+    # the admission pins were temporary: nothing stays pinned
+    assert pc.pinned_pages() == 0
+    cache.free(res_a.pages)
+
+
 def test_host_budget_bounds_tier_then_drops():
     """Past the host budget the coldest host leaf is dropped for room;
     with budget 0 the tier never holds anything."""
