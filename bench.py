@@ -623,6 +623,12 @@ def bench_decode(platform, peak):
     }
 
 
+def _hist_count(fam):
+    """Total observation count across a histogram family's children."""
+    return int(sum(child.snapshot()["count"]
+                   for _labels, child in fam.samples()))
+
+
 def bench_generation(platform, peak):
     """Continuous-batching decode (`deeplearning4j_tpu/generation/`):
     aggregate tokens/sec and p99 time-to-first-token at 1/4/16 concurrent
@@ -713,7 +719,14 @@ def bench_generation(platform, peak):
     drive(engine, 1)                      # jit caches hot before timing
     compiles_warm = mv.detector.compile_count
     arms = {}
+    slo_pre = itl_pre = None
     for n_clients in (1, 4, 16):
+        if n_clients == 16:
+            # the SLO-attribution evidence scopes to THIS arm: phase
+            # totals, busy-wall and ITL-histogram deltas over the driven
+            # 16-client window, not the warmup/small arms before it
+            slo_pre = engine.stats()
+            itl_pre = _hist_count(engine.metrics.inter_token)
         tps, ttfts, total = drive(engine, n_clients)
         arms[f"clients_{n_clients}"] = {
             "tokens_per_sec": round(tps, 1),
@@ -723,6 +736,35 @@ def bench_generation(platform, peak):
             "tokens": total,
         }
     steady_compiles = mv.detector.compile_count - compiles_warm
+    slo_post = engine.stats()
+    itl_count = _hist_count(engine.metrics.inter_token) - itl_pre
+    pre_ph = slo_pre["phases"]["phases"]
+    phase_ms = {}
+    for pname, pstat in slo_post["phases"]["phases"].items():
+        before = pre_ph.get(pname, {}).get("total_ms", 0.0)
+        phase_ms[pname] = round(pstat["total_ms"] - before, 3)
+    busy_ms = (slo_post["busy_wall_s"] - slo_pre["busy_wall_s"]) * 1e3
+    phase_cover = (sum(phase_ms.values()) / busy_ms) if busy_ms > 0 else 0.0
+    slo_d = engine.slo.as_dict()
+
+    # the publisher's no-new-host-sync contract: serialize one full fleet
+    # snapshot off the live engine with jax.device_get counted — the walk
+    # reads only host-side numbers, so ANY call is a new device sync
+    import jax as _jax
+
+    pub = engine.fleet_publisher("bench-probe")
+    real_get, syncs = _jax.device_get, [0]
+
+    def _counting_get(*a, **k):
+        syncs[0] += 1
+        return real_get(*a, **k)
+
+    _jax.device_get = _counting_get
+    try:
+        snap_bytes = len(pub.serialize())
+    finally:
+        _jax.device_get = real_get
+
     stats = engine.stats()["scheduler"]["cache"]
     engine.stop()
     c16 = arms["clients_16"]
@@ -812,6 +854,29 @@ def bench_generation(platform, peak):
         "steady_state_compiles": steady_compiles,
         "prefix_shared_pages": stats["shared_pages_total"],
         "arms": arms,
+        # decode SLO attribution over the 16-client window (fleet
+        # telemetry plane): per-phase wall breakdown must reconcile with
+        # the decode loop's busy wall within 10%, the ITL histogram must
+        # actually populate, and serializing a federated snapshot must
+        # add zero device->host syncs.  Sentinels are ints (the
+        # regression checker skips bools).
+        "slo": {
+            "targets": slo_d["targets"],
+            "finished": slo_d["finished"],
+            "ttft_attainment": slo_d["ttft_attainment"],
+            "itl_attainment": slo_d["itl_attainment"],
+            "good_attainment": slo_d["good_attainment"],
+            "goodput_rps": round(slo_d["goodput_rps"], 3),
+            "itl_histogram_count": itl_count,
+            "phase_ms": phase_ms,
+            "busy_wall_ms": round(busy_ms, 3),
+            "phase_coverage": round(phase_cover, 4),
+            "itl_populated": int(itl_count > 0),
+            "phase_sum_ok": int(0.9 <= phase_cover <= 1.1),
+            "publisher_snapshot_bytes": snap_bytes,
+            "publisher_host_syncs": syncs[0],
+            "publisher_host_sync_free": int(syncs[0] == 0),
+        },
         "prefix_cache": {
             "tokens_per_sec": round(p_tokens / p_wall, 1),
             "p99_ttft_hit_ms": round(p99_hit, 3),
@@ -1870,6 +1935,219 @@ def bench_numerics(platform, peak):
     }
 
 
+def bench_fleet(platform, peak):
+    """Fleet telemetry plane (observability/fleet.py) on record.
+
+    Arm 1 — publisher overhead: the bench transformer's fit step with a
+    ``TelemetryPublisher`` snapshotting the LIVE global registry at a
+    4 Hz cadence (8x the production default) vs publisher off,
+    interleaved per rep like the introspection bench.  The snapshot walk
+    reads only host-side Python numbers, so the budget is <2%.
+
+    Arm 2 — two-process federation over the broker's HTTP transport: a
+    subprocess publisher and an in-process one feed one
+    ``FleetAggregator``; reports the end-to-end publish->ingest lag and
+    runs the kill/restart drill — the dead worker must flip stale within
+    ``expire_after_s`` and be NAMED by fleet health, and the restarted
+    epoch must resume counter merging with no double-count and no
+    reset-to-zero."""
+    import subprocess
+
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+    from deeplearning4j_tpu.observability.fleet import (
+        FleetAggregator, TelemetryPublisher,
+    )
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.streaming import MessageBroker
+
+    # ---- arm 1: publisher overhead on the transformer train step -------
+    if platform == "tpu":
+        batch, seq, d_model, heads, layers = 8, 2048, 1024, 8, 8
+    else:
+        batch, seq, d_model, heads, layers = 2, 256, 64, 2, 1
+    vocab = 128
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, vocab, (batch, seq))
+    x = jnp.asarray(ids)
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, 1)])
+    warmup, iters, reps = 3, 30, 5
+    net = transformer_char_lm(
+        vocab_size=vocab, d_model=d_model, n_heads=heads, layers=layers,
+        compute_dtype="bfloat16" if platform == "tpu" else None)
+
+    def timed_loop():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            net.fit(x, y)
+        _sync(net._score)
+        return (time.perf_counter() - t0) / iters
+
+    # snapshots the GLOBAL registry (every family the bench run has
+    # registered so far — the realistic payload), published to a broker
+    # with no subscribers so only serialize+publish cost is measured
+    pub = TelemetryPublisher("bench-w0", broker=MessageBroker(),
+                             interval_s=0.25)
+    for _ in range(warmup):
+        net.fit(x, y)
+    _sync(net._score)
+    snap_bytes = len(pub.serialize())
+    t_pub0 = time.perf_counter()
+    pub.publish_once()
+    publish_ms = (time.perf_counter() - t_pub0) * 1e3
+    # interleave the arms with ALTERNATING order per rep: slow-container
+    # drift (the dominant CPU noise, monotonic within a rep pair) then
+    # penalizes each arm equally often; compare best-rep times because
+    # the publisher's cost is additive per interval — the fastest rep of
+    # each arm samples the same quiet-container state, while medians
+    # conflate drift with the arm under test
+    t_off, t_on = [], []
+    for r in range(reps + reps % 2):
+        first_off = r % 2 == 0
+        if first_off:
+            t_off.append(timed_loop())
+        pub.start()
+        t_on.append(timed_loop())
+        pub.stop()
+        if not first_off:
+            t_off.append(timed_loop())
+    off_s = float(np.min(t_off))
+    on_s = float(np.min(t_on))
+    overhead = on_s / off_s - 1.0
+
+    # ---- arm 2: two-process federation + kill/restart drill ------------
+    drill = "dl4j_fleet_drill_total"
+    drill_help = "Work items processed by the fleet bench federation drill"
+    topic = "bench.fleet"
+    broker = MessageBroker()
+    port = broker.serve(port=0)
+    url = f"http://127.0.0.1:{port}"
+    agg = FleetAggregator(url=url, topic=topic, expire_after_s=1.0,
+                          registry=MetricsRegistry()).start()
+    time.sleep(0.5)   # the first long-poll registers the subscription
+
+    wreg = MetricsRegistry()
+    # dl4jlint: disable-next-line=metrics-docs -- bench drill-only family
+    wc = wreg.counter(drill, drill_help, labels=("kind",))
+    wpub = TelemetryPublisher("w-local", url=url, topic=topic,
+                              registry=wreg, interval_s=0.1)
+    wc.inc(5, kind="local")
+    wpub.start()
+
+    sub_script = (
+        "import sys, time\n"
+        "from deeplearning4j_tpu.observability.fleet import "
+        "TelemetryPublisher\n"
+        "from deeplearning4j_tpu.observability.metrics import "
+        "MetricsRegistry\n"
+        "reg = MetricsRegistry()\n"
+        f"c = reg.counter({drill!r}, {drill_help!r}, labels=('kind',))\n"
+        "pub = TelemetryPublisher('w-remote', url=sys.argv[1], "
+        f"topic={topic!r}, registry=reg)\n"
+        "for _ in range(4):\n"
+        "    c.inc(10, kind='drill')\n"
+        "    if pub.publish_once() < 0:\n"
+        "        sys.exit(3)\n"
+        "    time.sleep(0.05)\n")
+
+    def run_remote():
+        proc = subprocess.run(
+            [sys.executable, "-c", sub_script, url],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=300)
+        if proc.returncode != 0:
+            raise RuntimeError("fleet drill publisher failed: "
+                               + proc.stderr[-300:])
+
+    def worker_row(name):
+        for w in agg.workers():
+            if w["worker"] == name:
+                return w
+        return None
+
+    def drill_total(worker):
+        for fam in agg.registry().families():
+            if fam.name == drill:
+                return sum(child.value
+                           for label_pairs, child in fam.samples()
+                           if dict(label_pairs).get("worker") == worker)
+        return 0.0
+
+    def wait_for(cond, timeout=20.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if cond():
+                return True
+            time.sleep(0.05)
+        return False
+
+    run_remote()                      # run 1: epoch A, totals 10..40
+    seen = wait_for(lambda: (worker_row("w-remote") or {}).get("seq",
+                                                               0) >= 4)
+    merged_run1 = drill_total("w-remote")
+    # kill drill: the process already exited — within expire_after_s the
+    # worker must flip stale and the fleet verdict must NAME it
+    stale_seen = wait_for(
+        lambda: (worker_row("w-remote") or {}).get("stale") is True,
+        timeout=10.0)
+    verdict = agg.evaluate_health()
+    stale_named = int(any(
+        "w-remote" in str(r) for r in verdict.results if not r["ok"]))
+    # restart drill: a NEW epoch re-counts 10..40 from zero — the merge
+    # must add the fresh totals onto the old history (80), neither
+    # double-counting a replay nor resetting to the new base
+    run_remote()
+    wait_for(lambda: (worker_row("w-remote") or {}).get("snapshots",
+                                                        0) >= 8)
+    wait_for(lambda: (worker_row("w-remote") or {}).get("stale") is False,
+             timeout=5.0)
+    merged_run2 = drill_total("w-remote")
+    healthy_after = agg.evaluate_health().healthy
+    pairs = agg._m_lag.samples()
+    lag = (pairs[0][1].snapshot() if pairs
+           else {"count": 0, "sum": 0.0})
+    lag_ms = (lag["sum"] / lag["count"] * 1e3) if lag["count"] else None
+    local_total = drill_total("w-local")
+    wpub.stop()
+    agg.stop()
+    broker.stop()
+
+    return {
+        "metric": (f"Fleet telemetry ingest lag (2 publishers over HTTP "
+                   f"broker, d{d_model} L{layers} overhead probe)"),
+        "value": round(lag_ms, 3) if lag_ms is not None else None,
+        "unit": "ms",
+        "vs_baseline": None,   # no reference analog (fleet plane is new)
+        "data": "synthetic",
+        "dtype": "bfloat16" if platform == "tpu" else "float32",
+        "publisher_on_ms": round(on_s * 1e3, 3),
+        "publisher_off_ms": round(off_s * 1e3, 3),
+        "publisher_overhead_frac": round(overhead, 4),
+        "publisher_overhead_ok": int(overhead < 0.02),
+        "publish_ms": round(publish_ms, 3),
+        "snapshot_bytes": snap_bytes,
+        "spread": {"reps": reps,
+                   "on_rep_ms": [round(t * 1e3, 3) for t in t_on],
+                   "off_rep_ms": [round(t * 1e3, 3) for t in t_off]},
+        "federation": {
+            "ingest_lag_ms_mean": (round(lag_ms, 3)
+                                   if lag_ms is not None else None),
+            "ingested_snapshots": int(lag["count"]),
+            "remote_seen": int(bool(seen)),
+            "stale_detected": int(bool(stale_seen)),
+            "stale_worker_named": stale_named,
+            "merged_after_run1": merged_run1,
+            "merged_after_restart": merged_run2,
+            "restart_merge_ok": int(abs(merged_run2 - 2 * merged_run1)
+                                    < 1e-9 and merged_run1 == 40.0),
+            "local_counter_merged": local_total,
+            "fleet_healthy_after_restart": int(bool(healthy_after)),
+            "merge_skips": agg.fleet_table()["merge_skips"],
+        },
+    }
+
+
 def _performance_attribution(metrics, dev):
     """The observability.performance section: step FLOPs, MFU (spec-sheet
     peak on TPU, documented CPU estimate otherwise — always labeled), and
@@ -1933,7 +2211,8 @@ def main():
             ("online", lambda: bench_online(platform, peak)),
             ("stability", lambda: bench_stability(platform, peak)),
             ("introspection", lambda: bench_introspection(platform, peak)),
-            ("numerics", lambda: bench_numerics(platform, peak))):
+            ("numerics", lambda: bench_numerics(platform, peak)),
+            ("fleet", lambda: bench_fleet(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

@@ -52,6 +52,8 @@ from deeplearning4j_tpu.generation.scheduler import (
 from deeplearning4j_tpu.observability.flightrecorder import (
     get_flight_recorder, step_guard,
 )
+from deeplearning4j_tpu.observability.fleet import SLOTracker
+from deeplearning4j_tpu.observability.phases import PhaseTimers
 from deeplearning4j_tpu.observability.servingmetrics import GenerationMetrics
 from deeplearning4j_tpu.observability.tracing import get_tracer
 from deeplearning4j_tpu.serving.admission import ModelNotFoundError
@@ -75,7 +77,7 @@ class GenerationEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  models: Optional[ModelRegistry] = None, registry=None,
                  default_model: str = DEFAULT_MODEL,
-                 prefix_cache=None):
+                 prefix_cache=None, slo_targets: Optional[dict] = None):
         if max_context < 2:
             raise ValueError(f"max_context={max_context} must be >= 2")
         pages_per_slot = -(-int(max_context) // int(page_size))
@@ -84,6 +86,17 @@ class GenerationEngine:
             # so admission only ever sheds on the queue budget
             num_pages = slots * pages_per_slot + 1
         self.metrics = GenerationMetrics(registry)
+        # decode SLO attribution: TTFT/ITL attainment + goodput against
+        # configurable targets (slo_targets={"ttft_target_s": ...,
+        # "itl_target_s": ...}), federated via fleet_publisher()
+        self.slo = SLOTracker(registry=self.metrics.registry,
+                              engine_id=self.metrics.engine_id,
+                              **(slo_targets or {}))
+        # per-iteration phase breakdown of the decode loop (schedule /
+        # page_gather / jitted_step / sample_harvest / stream_write)
+        self.phases = PhaseTimers("generation_decode",
+                                  registry=self.metrics.registry)
+        self.busy_wall_s = 0.0          # decode-loop wall time, non-wait
         self.models = models or ModelRegistry(
             metrics_registry=self.metrics.registry)
         self.default_model = default_model
@@ -338,7 +351,9 @@ class GenerationEngine:
             if stopping and (not self._drain
                              or not self.scheduler.has_work):
                 break
-            self.scheduler.purge_pending()
+            t_iter = time.perf_counter()
+            with self.phases.phase("schedule"):
+                self.scheduler.purge_pending()
             try:
                 with self.models.lease(self.default_model) as mv:
                     progs = self._programs[mv.key]
@@ -356,6 +371,7 @@ class GenerationEngine:
                     self._admit(progs, mv)
                     if self.scheduler.active_slots():
                         self._step(progs, mv)
+                        self.busy_wall_s += time.perf_counter() - t_iter
                         continue
             except Exception as e:
                 logger.exception("decode iteration failed; evicting the "
@@ -374,12 +390,14 @@ class GenerationEngine:
                     logger.exception("pool reseed failed; decode thread "
                                      "exiting")
                     return
+            self.busy_wall_s += time.perf_counter() - t_iter
             if not stopping and not self.scheduler.has_work:
                 self.scheduler.wait_for_work(0.05)
 
     def _admit(self, progs: GenerationPrograms, mv: ModelVersion) -> None:
         while True:
-            req = self.scheduler.next_admittable()
+            with self.phases.phase("schedule"):
+                req = self.scheduler.next_admittable()
             if req is None:
                 return
             try:
@@ -395,69 +413,89 @@ class GenerationEngine:
 
     def _prefill(self, progs: GenerationPrograms, mv: ModelVersion,
                  req: GenerationRequest) -> None:
-        suffix = req.prompt[req.shared_len:]
-        bucket = progs.bucket_for(len(suffix))
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :len(suffix)] = suffix
-        shared_pages = req.shared_len // self.cache.page_size
-        base_key = _base_key(req.seed)
+        with self.phases.phase("page_gather"):
+            suffix = req.prompt[req.shared_len:]
+            bucket = progs.bucket_for(len(suffix))
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :len(suffix)] = suffix
+            shared_pages = req.shared_len // self.cache.page_size
+            base_key = _base_key(req.seed)
+            block = self.cache.block_row(req.pages)[None]
         with step_guard("decode_prefill", engine=self.metrics.engine_id,
                         bucket=bucket, shared_pages=shared_pages):
-            self._pools, tok = progs.prefill(
-                bucket, mv.model.params, mv.model.net_state, self._pools,
-                self.cache.block_row(req.pages)[None],
-                np.asarray([req.shared_len], np.int32),
-                np.int32(len(suffix) - 1), tokens, base_key[None],
-                np.zeros(1, np.int32),
-                np.asarray([req.temperature], np.float32),
-                np.asarray([req.top_k], np.int32),
-                np.asarray([req.top_p], np.float32))
-        first = int(jax.device_get(tok)[0])
-        self.scheduler.install(req, first, base_key)
-        self.metrics.ttft.observe(req.ttft_s)
-        self.metrics.prefix_pages.inc(shared_pages, outcome="shared")
-        self.metrics.prefix_pages.inc(len(req.pages) - shared_pages,
-                                      outcome="fresh")
-        self.metrics.tokens.inc(model=mv.name)
-        self._refresh_gauges()
+            with self.phases.phase("jitted_step"):
+                self._pools, tok = progs.prefill(
+                    bucket, mv.model.params, mv.model.net_state,
+                    self._pools, block,
+                    np.asarray([req.shared_len], np.int32),
+                    np.int32(len(suffix) - 1), tokens, base_key[None],
+                    np.zeros(1, np.int32),
+                    np.asarray([req.temperature], np.float32),
+                    np.asarray([req.top_k], np.int32),
+                    np.asarray([req.top_p], np.float32))
+        with self.phases.phase("sample_harvest"):
+            first = int(jax.device_get(tok)[0])
+        with self.phases.phase("stream_write"):
+            self.scheduler.install(req, first, base_key)
+            self.metrics.ttft.observe(req.ttft_s)
+            self.metrics.prefix_pages.inc(shared_pages, outcome="shared")
+            self.metrics.prefix_pages.inc(len(req.pages) - shared_pages,
+                                          outcome="fresh")
+            self.metrics.tokens.inc(model=mv.name)
+            self._refresh_gauges()
 
     def _step(self, progs: GenerationPrograms, mv: ModelVersion) -> None:
         s = self.scheduler
         active = len(s.active_slots())
         with step_guard("decode_step", engine=self.metrics.engine_id,
                         active=active):
-            self._pools, sampled = progs.decode(
-                mv.model.params, mv.model.net_state, self._pools,
-                s.block, s.pos, s.last_tok, s.keys, s.tok_idx, s.temps,
-                s.top_ks, s.top_ps)
-        delivered = s.after_step(jax.device_get(sampled))
-        self.steady_deliveries += delivered
-        self.metrics.steps.inc()
-        self.metrics.tokens.inc(delivered, model=mv.name)
-        self.metrics.batch_occupancy.observe(active / s.num_slots)
-        self._refresh_gauges()
+            with self.phases.phase("jitted_step"):
+                self._pools, sampled = progs.decode(
+                    mv.model.params, mv.model.net_state, self._pools,
+                    s.block, s.pos, s.last_tok, s.keys, s.tok_idx,
+                    s.temps, s.top_ks, s.top_ps)
+        with self.phases.phase("sample_harvest"):
+            sampled_host = jax.device_get(sampled)
+        with self.phases.phase("stream_write"):
+            delivered = s.after_step(sampled_host)
+            self.steady_deliveries += delivered
+            self.metrics.steps.inc()
+            self.metrics.tokens.inc(delivered, model=mv.name)
+            self.metrics.batch_occupancy.observe(active / s.num_slots)
+            self._refresh_gauges()
 
     def _refresh_gauges(self) -> None:
         self.metrics.active_slots.set(len(self.scheduler.active_slots()))
         self.metrics.page_util.set(self.cache.utilization())
         if self.prefix_cache is not None:
-            pc = self.prefix_cache
-            self.metrics.prefix_cache_resident.set(pc.resident_pages())
-            self.metrics.prefix_cache_pinned.set(pc.pinned_pages())
-            self.metrics.prefix_cache_host_bytes.set(pc.host_bytes)
+            # one locked snapshot — three separate reads could tear
+            # across a concurrent eviction/offload (resident dropping
+            # while host_bytes had not risen yet)
+            st = self.prefix_cache.stats()
+            self.metrics.prefix_cache_resident.set(st["resident_pages"])
+            self.metrics.prefix_cache_pinned.set(st["pinned_pages"])
+            self.metrics.prefix_cache_host_bytes.set(
+                st["host_tier_bytes"])
 
     def _on_finish(self, req: GenerationRequest) -> None:
         """Terminal accounting for every request, whatever path ended it
         (completion, stop token, cancel, deadline, shutdown, error)."""
         status = req.finish_reason or "error"
         self.metrics.requests.inc(status=status)
+        # SLO verdict BEFORE the waiters wake (scheduler calls on_finish
+        # before releasing them), so access logs and req.as_dict() read
+        # a settled slo_ok
+        req.slo_ok = self.slo.observe_request(
+            ttft_s=req.ttft_s, itl_s=req.itl_s,
+            completed=status in _OK_REASONS)
         end_ns = time.perf_counter_ns()
         start_ns = int(req.submitted * 1e9)
         get_tracer().record_span(
             "generation_request", start_ns, end_ns,
             trace_id=req.trace_id, tokens=len(req.tokens), status=status,
             ttft_ms=(round(req.ttft_s * 1e3, 3)
-                     if req.ttft_s is not None else None))
+                     if req.ttft_s is not None else None),
+            itl_p50_ms=req.itl_p50_ms(), slo_ok=req.slo_ok)
 
     def kv_numerics(self, allocated_only: bool = True) -> dict:
         """Per-page dynamic-range ledger over the live KV pools
@@ -486,7 +524,27 @@ class GenerationEngine:
             "prefill_buckets": list(self.prefill_buckets),
             "decode_thread_alive": (self._thread is not None
                                     and self._thread.is_alive()),
+            "phases": self.phases.as_dict(),
+            "busy_wall_s": round(self.busy_wall_s, 6),
+            "slo": self.slo.as_dict(),
         }
+
+    def fleet_publisher(self, worker_id: str, **kw):
+        """A ``TelemetryPublisher`` pre-wired to this engine: local
+        registry, SLO tracker, one-locked-snapshot prefix-cache stats,
+        and the scheduler state dict.  Caller supplies the transport
+        (``broker=`` or ``url=``) and calls ``start()``.  Reads only
+        host-side state — publishing never touches the device."""
+        from deeplearning4j_tpu.observability.fleet import (
+            TelemetryPublisher,
+        )
+        kw.setdefault("registry", self.metrics.registry)
+        kw.setdefault("slo", self.slo)
+        if self.prefix_cache is not None:
+            kw.setdefault("prefix_cache", self.prefix_cache)
+        kw.setdefault("state_fn",
+                      lambda: {"scheduler": self.scheduler.as_dict()})
+        return TelemetryPublisher(worker_id, **kw)
 
     def cache_stats(self) -> dict:
         """The ``GET /generation/cache`` payload: allocator occupancy

@@ -73,6 +73,9 @@ class GenerationRequest:
         self.trace_id = trace_id or new_trace_id()
         self.submitted = time.perf_counter()
         self.ttft_s: Optional[float] = None
+        self.itl_s: List[float] = []    # gaps between delivered tokens
+        self._last_token_t: Optional[float] = None
+        self.slo_ok: Optional[bool] = None   # set by the engine's SLOTracker
         self.finish_reason: Optional[str] = None   # length|stop|cancelled…
         self.tokens: List[int] = []
         self.error: Optional[Exception] = None
@@ -116,14 +119,31 @@ class GenerationRequest:
 
     # -------------------------------------------------------- delivery side
     def _deliver(self, token: int) -> None:
+        now = time.perf_counter()
         if self.ttft_s is None:
-            self.ttft_s = time.perf_counter() - self.submitted
+            self.ttft_s = now - self.submitted
+        else:
+            self.itl_s.append(now - self._last_token_t)
+        self._last_token_t = now
         self.tokens.append(int(token))
         self._stream.put(int(token))
+
+    def itl_p50_ms(self) -> Optional[float]:
+        """Median inter-token gap in ms (None before the second token) —
+        the per-request SLO evidence the access log carries."""
+        if not self.itl_s:
+            return None
+        vs = sorted(self.itl_s)
+        mid = len(vs) // 2
+        p50 = vs[mid] if len(vs) % 2 else 0.5 * (vs[mid - 1] + vs[mid])
+        return round(p50 * 1e3, 3)
 
     def _finish(self, reason: str, error: Optional[Exception] = None) -> None:
         self.finish_reason = reason
         self.error = error
+        self._release_waiters()
+
+    def _release_waiters(self) -> None:
         self._stream.put(_DONE)
         self.done.set()
 
@@ -133,7 +153,9 @@ class GenerationRequest:
                 "max_new_tokens": self.max_new_tokens,
                 "finish_reason": self.finish_reason,
                 "ttft_ms": (round(self.ttft_s * 1e3, 3)
-                            if self.ttft_s is not None else None)}
+                            if self.ttft_s is not None else None),
+                "itl_p50_ms": self.itl_p50_ms(),
+                "slo_ok": self.slo_ok}
 
 
 class _Slot:
@@ -162,7 +184,9 @@ class DecodeScheduler:
             metrics=metrics)
         self.metrics = metrics
         # terminal hook (engine accounting): called once per request on
-        # ANY terminal path, after the request's done event is set
+        # ANY terminal path, BEFORE the request's done event is set — so
+        # per-request verdicts the hook computes (slo_ok) are visible the
+        # moment result()/stream() return
         self.on_finish = None
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
@@ -231,11 +255,18 @@ class DecodeScheduler:
 
     def _terminate(self, req: GenerationRequest, reason: str,
                    error: Optional[Exception] = None) -> None:
-        if req.done.is_set():
+        if req.done.is_set() or req.finish_reason is not None:
             return   # already terminal (stop() races the loop's own end)
-        req._finish(reason, error)
-        if self.on_finish is not None:
-            self.on_finish(req)
+        req.finish_reason = reason
+        req.error = error
+        try:
+            # accounting BEFORE the waiters wake: the hook stamps the
+            # request (slo_ok) and a client reading result() right after
+            # done.set() must see the stamp, not race it
+            if self.on_finish is not None:
+                self.on_finish(req)
+        finally:
+            req._release_waiters()
 
     @property
     def queued(self) -> int:
@@ -371,6 +402,8 @@ class DecodeScheduler:
             self.tok_idx[i] += 1
             slot.generated += 1
             req._deliver(tok)
+            if self.metrics is not None and req.itl_s:
+                self.metrics.inter_token.observe(req.itl_s[-1])
             delivered += 1
             if not self._maybe_finish(i, tok) and (
                     req.cancelled or now > req.deadline):

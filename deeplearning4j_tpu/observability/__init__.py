@@ -35,6 +35,9 @@ from deeplearning4j_tpu.observability.shardstats import (
     record_model_ledger, ring_wire_bytes, sharding_ledger,
 )
 from deeplearning4j_tpu.observability.phases import PhaseTimers
+from deeplearning4j_tpu.observability.fleet import (
+    FleetAggregator, SLOTracker, TelemetryPublisher,
+)
 from deeplearning4j_tpu.observability.fitmetrics import (
     FitTelemetry, fit_telemetry,
 )
@@ -70,7 +73,8 @@ __all__ = [
     "latest_ledgers", "link_bandwidth_for", "program_analysis",
     "record_ledger", "record_model_ledger", "ring_wire_bytes",
     "sharding_ledger",
-    "PhaseTimers", "FitTelemetry", "fit_telemetry", "ServingMetrics",
+    "PhaseTimers", "FleetAggregator", "SLOTracker", "TelemetryPublisher",
+    "FitTelemetry", "fit_telemetry", "ServingMetrics",
     "ClusterStatsAggregator", "HealthEvaluator", "HealthRule",
     "HealthVerdict", "StragglerDetector", "WorkerTelemetry",
     "default_serving_rules", "default_training_rules", "histogram_quantile",

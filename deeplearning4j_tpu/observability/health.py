@@ -276,26 +276,71 @@ class ClusterStatsAggregator:
     (the Spark-driver stage-timing pattern without the driver in the data
     path)."""
 
+    #: schema tag a wire-delivered snapshot MAY carry.  Absent = legacy
+    #: in-process snapshot (accepted); equal = accepted; anything else
+    #: was produced by a worker this process does not understand and is
+    #: skipped with a log line, never raised on.
+    SNAPSHOT_SCHEMA = 1
+
+    @staticmethod
+    def _usable(s: Any) -> bool:
+        """Tolerant per-snapshot gate for wire-delivered dicts from
+        heterogeneous workers: non-dicts, mismatched schema tags and
+        unparseable counts are log-and-skip; unknown extra keys ride
+        through untouched; a missing/zero count is silently empty
+        (pre-existing semantics)."""
+        if not isinstance(s, dict):
+            if s:   # None/{} stay silent — the legacy empty-slot case
+                logger.warning("cluster merge: skipping non-dict "
+                               "snapshot (%s)", type(s).__name__)
+            return False
+        schema = s.get("schema", ClusterStatsAggregator.SNAPSHOT_SCHEMA)
+        if schema != ClusterStatsAggregator.SNAPSHOT_SCHEMA:
+            logger.warning("cluster merge: skipping snapshot from %r "
+                           "with unknown schema %r",
+                           s.get("worker"), schema)
+            return False
+        count = s.get("count")
+        if count is None or count == 0:
+            return False
+        if not isinstance(count, (int, float)) or isinstance(count, bool):
+            logger.warning("cluster merge: skipping snapshot from %r "
+                           "with unparseable count %r",
+                           s.get("worker"), count)
+            return False
+        return True
+
+    @staticmethod
+    def _f(v: Any) -> Optional[float]:
+        """A numeric field or None — wire snapshots may carry anything."""
+        return float(v) if (isinstance(v, (int, float))
+                            and not isinstance(v, bool)) else None
+
     @staticmethod
     def merge(snapshots: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
-        snapshots = [s for s in snapshots if s and s.get("count")]
+        _f = ClusterStatsAggregator._f
+        snapshots = [s for s in (snapshots or ())
+                     if ClusterStatsAggregator._usable(s)]
         pooled: List[float] = []
         throughput = 0.0
         has_tput = False
         slowest = None
         for s in snapshots:
-            pooled.extend(s.get("samples") or [])
-            sps = s.get("samples_per_second")
+            samples = s.get("samples")
+            if isinstance(samples, (list, tuple)):
+                pooled.extend(v for v in map(_f, samples)
+                              if v is not None)
+            sps = _f(s.get("samples_per_second"))
             if sps:
                 throughput += sps
                 has_tput = True
-            if slowest is None or (s.get("mean") or 0) > (
-                    slowest.get("mean") or 0):
+            if slowest is None or (_f(s.get("mean")) or 0) > (
+                    _f(slowest.get("mean")) or 0):
                 slowest = s
         view: Dict[str, Any] = {
             "workers": len(snapshots),
-            "steps": sum(s["count"] for s in snapshots),
-            "slowest_worker": slowest["worker"] if slowest else None,
+            "steps": int(sum(s["count"] for s in snapshots)),
+            "slowest_worker": slowest.get("worker") if slowest else None,
             "samples_per_second_total": throughput if has_tput else None,
             "per_worker": [
                 {k: v for k, v in s.items() if k != "samples"}

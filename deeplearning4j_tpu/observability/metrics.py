@@ -170,6 +170,30 @@ class Histogram:
         """Context manager observing the elapsed seconds of the block."""
         return _HistogramTimer(self)
 
+    def restore(self, bucket_counts: Sequence[int], sum: float, count: int,
+                min: Optional[float] = None,
+                max: Optional[float] = None) -> None:
+        """Overwrite this histogram's state from an exported snapshot —
+        the import half of the fleet-federation wire format
+        (observability.fleet): per-bucket counts, running sum/count, and
+        optional min/max (NaN when the exporter didn't carry them, so a
+        merged histogram never fabricates extremes)."""
+        bucket_counts = [int(c) for c in bucket_counts]
+        if len(bucket_counts) != len(self.buckets):
+            raise ValueError(
+                f"restore() got {len(bucket_counts)} bucket counts for "
+                f"{len(self.buckets)} buckets")
+        with self._lock:
+            self._bucket_counts = bucket_counts
+            self._sum = float(sum)
+            self._count = int(count)
+            if self._count:
+                self._min = float("nan") if min is None else float(min)
+                self._max = float("nan") if max is None else float(max)
+            else:
+                self._min = math.inf
+                self._max = -math.inf
+
     @property
     def count(self) -> int:
         # dl4jlint: disable-next-line=lock-discipline -- monitoring read of one GIL-atomic int; snapshot() is the consistent view

@@ -115,6 +115,18 @@ DEFAULT_RULES: List[Rule] = [
     Rule("Generation tokens/sec",
          field="prefix_cache.steady_state_compiles",
          direction=LOWER, tolerance=0.0, required=False),
+    # decode SLO attribution (ISSUE 18): the ITL histogram must stay
+    # populated under the 16-client window, the per-phase breakdown must
+    # keep reconciling with the decode loop's busy wall (within 10% —
+    # phase_sum_ok pins it), and serializing a federated snapshot must
+    # stay host-side only (publisher_host_sync_free: any new device
+    # sync drops the sentinel to 0 and fails immediately)
+    Rule("Generation tokens/sec", field="slo.itl_populated",
+         tolerance=0.0, required=False),
+    Rule("Generation tokens/sec", field="slo.phase_sum_ok",
+         tolerance=0.0, required=False),
+    Rule("Generation tokens/sec", field="slo.publisher_host_sync_free",
+         tolerance=0.0, required=False),
     Rule("Long-context train tokens/sec", tolerance=0.4),
     Rule("Serving rows/sec", tolerance=0.4),
     Rule("Serving rows/sec", field="p99_ms", direction=LOWER, tolerance=1.0,
@@ -161,6 +173,24 @@ DEFAULT_RULES: List[Rule] = [
          tolerance=0.0, required=False),
     Rule("Numerics-ledger train step", field="steady_state_compiles",
          direction=LOWER, tolerance=0.0, required=False),
+    # fleet telemetry plane (bench_fleet, ISSUE 18): publish->ingest lag
+    # across the two-process federation must stay bounded (lower; wide
+    # tolerance — the HTTP long-poll handoff jitters on a loaded CPU),
+    # publisher_overhead_ok pins the <2%-on-the-train-step contract, and
+    # the kill/restart drill's verdicts must stay 1: the dead worker is
+    # detected AND named, and the restarted epoch merges with no
+    # double-count and no reset-to-zero
+    Rule("Fleet telemetry ingest lag", direction=LOWER, tolerance=3.0),
+    Rule("Fleet telemetry ingest lag", field="publisher_overhead_ok",
+         tolerance=0.0, required=False),
+    Rule("Fleet telemetry ingest lag", field="federation.stale_detected",
+         tolerance=0.0, required=False),
+    Rule("Fleet telemetry ingest lag",
+         field="federation.stale_worker_named",
+         tolerance=0.0, required=False),
+    Rule("Fleet telemetry ingest lag",
+         field="federation.restart_merge_ok",
+         tolerance=0.0, required=False),
     # memory & collective-communication sentinels (bench _memory_measure
     # -> observability.memory.sentinels): FLIPPED to the ZeRO baselines
     # by the update-sharding PR (ROADMAP item 2, arXiv 2004.13336) — the

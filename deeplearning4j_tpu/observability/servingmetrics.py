@@ -92,6 +92,11 @@ class ServingMetrics:
 _TTFT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
                  1.0, 2.5, 5.0, 10.0)
 
+# inter-token latency sits an order of magnitude below TTFT (one decode
+# step vs queue+prefill), so the buckets start at the dispatch floor
+_ITL_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5)
+
 
 class GenerationMetrics:
     """Decode/continuous-batching families (``dl4j_decode_*``) — the one
@@ -152,6 +157,11 @@ class GenerationMetrics:
             "dl4j_decode_ttft_seconds",
             "Time to first token: submit -> first sampled token delivered "
             "(queue wait + prefill)", buckets=_TTFT_BUCKETS)
+        self.inter_token = reg.histogram(
+            "dl4j_decode_inter_token_seconds",
+            "Inter-token latency: gap between consecutive delivered "
+            "tokens of one request (the streaming-smoothness half of the "
+            "decode SLO; TTFT is the other)", buckets=_ITL_BUCKETS)
         self.shed = reg.counter(
             "dl4j_decode_shed_total",
             "Generation requests shed by admission control, by reason",

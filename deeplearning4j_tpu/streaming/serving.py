@@ -177,7 +177,9 @@ class InferenceServer:
     def _gen_access_line(self, trace_id: str, status: str, http_status: int,
                          req=None) -> None:
         """The /generate analog of ``_access_line``: same logger, same
-        trace-id key, generation-shaped fields (token count, TTFT)."""
+        trace-id key, generation-shaped fields (token count, TTFT,
+        inter-token p50, SLO verdict — the per-request SLO evidence
+        that survives outside the metrics window)."""
         if not self.access_log:
             return
         try:
@@ -190,6 +192,10 @@ class InferenceServer:
                 "ttft_ms": (round(req.ttft_s * 1e3, 3)
                             if req is not None and req.ttft_s is not None
                             else None),
+                "itl_p50_ms": (req.itl_p50_ms()
+                               if req is not None else None),
+                "slo_ok": (getattr(req, "slo_ok", None)
+                           if req is not None else None),
                 "finish_reason": (req.finish_reason
                                   if req is not None else None),
             }))

@@ -163,6 +163,10 @@ class UIServer:
     - ``GET /generation/cache`` — paged-pool occupancy + persistent
       prefix-cache stats of an attached ``GenerationEngine``
       (``attach_generation``); 404 until one is attached.
+    - ``GET /fleet`` / ``GET /fleet/metrics`` — an attached
+      ``FleetAggregator``'s per-worker table and its merged
+      worker-labeled registry (``attach_fleet``); 404 until one is
+      attached.
     """
 
     def __init__(self, storage: Optional[StatsStorage] = None, port: int = 0,
@@ -170,6 +174,7 @@ class UIServer:
         self.storage = storage or InMemoryStatsStorage()
         self._registry = registry
         self.generation = None   # attach_generation()
+        self.fleet = None        # attach_fleet()
         self.health = health or HealthEvaluator(
             default_training_rules(), component="training",
             registry=registry)
@@ -184,6 +189,13 @@ class UIServer:
         """Expose a ``GenerationEngine``'s cache stats on
         ``GET /generation/cache`` (the serving-side twin of /memory)."""
         self.generation = engine
+
+    def attach_fleet(self, aggregator) -> None:
+        """Expose a ``FleetAggregator``'s per-worker table on
+        ``GET /fleet`` and its merged worker-labeled registry on
+        ``GET /fleet/metrics`` — the training UI doubles as the
+        fleet-operator console without running a second HTTP server."""
+        self.fleet = aggregator
 
     # ------------------------------------------------------------- queries
     def compare_sessions(self, sids: List[str],
@@ -484,6 +496,32 @@ class UIServer:
                                     "attach_generation)"}, 404)
                     else:
                         self._json(ui.generation.cache_stats())
+                elif path == "/fleet":
+                    # per-worker snapshot table + staleness (the
+                    # aggregator's own /fleet, mirrored into the UI)
+                    if ui.fleet is None:
+                        self._json({"error": "no fleet aggregator "
+                                    "attached (UIServer.attach_fleet)"},
+                                   404)
+                    else:
+                        self._json(ui.fleet.fleet_table())
+                elif path == "/fleet/metrics":
+                    if ui.fleet is None:
+                        self._json({"error": "no fleet aggregator "
+                                    "attached (UIServer.attach_fleet)"},
+                                   404)
+                    else:
+                        reg = ui.fleet.registry()
+                        ui.fleet.evaluate_health(reg)
+                        body = reg.to_prometheus().encode()
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
                 elif path == "/health":
                     verdict = ui.health.evaluate()
                     self._json(verdict.to_dict(),

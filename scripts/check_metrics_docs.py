@@ -23,7 +23,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:   # file-path loads have no package context
     sys.path.insert(0, REPO)
 
-from scripts.dl4jlint.core import iter_source_files, load_contexts  # noqa: E402
+from scripts.dl4jlint.core import (  # noqa: E402
+    iter_source_files, load_contexts, run_rules,
+)
 from scripts.dl4jlint.rules import metrics_docs as _rule  # noqa: E402
 
 
@@ -53,8 +55,10 @@ def run_lint(loaded=None) -> List[str]:
     """Returns a list of violations (empty = clean).  ``loaded`` is an
     optional pre-parsed ``(ctxs, errors)`` pair (see ``main``)."""
     ctxs, errors = loaded if loaded is not None else _contexts()
-    findings = list(_rule.MetricsDocsRule().finalize(ctxs))
-    return list(errors) + [f.message for f in findings]
+    # run_rules (not finalize directly) so dl4jlint suppression comments
+    # apply here exactly as in the full suite
+    res = run_rules([_rule.MetricsDocsRule()], ctxs, list(errors))
+    return list(res.errors) + [f.message for f in res.findings]
 
 
 def main() -> int:

@@ -10,11 +10,14 @@ Runs, in order:
    metrics-docs rule with dl4jlint, so this is a wiring check);
 3. ``check_bench_regression --self-test`` — the bench sentinel's
    rule-engine unit checks plus a self-compare of the committed
-   ``bench_full.json``.
+   ``bench_full.json``;
+4. ``fleet schema self-test`` — the fleet telemetry snapshot's
+   serialize → merge → re-export round trip must be bit-stable
+   (``observability.fleet.schema_roundtrip_selftest``).
 
-All three are pure source/JSON analysis — no jax import, a few seconds
-total — so this is the pre-test gate: run it before the pytest tiers
-and fail fast on lint debt or a broken sentinel.
+All four run in a few seconds with no device work — this is the
+pre-test gate: run it before the pytest tiers and fail fast on lint
+debt, a broken sentinel, or a fleet wire-schema drift.
 
 Usage::
 
@@ -45,6 +48,12 @@ CHECKS: List[Tuple[str, List[str]]] = [
      [sys.executable, os.path.join(REPO, "scripts",
                                    "check_bench_regression.py"),
       "--self-test"]),
+    ("fleet schema self-test",
+     [sys.executable, "-c",
+      "import sys; "
+      "from deeplearning4j_tpu.observability.fleet import "
+      "schema_roundtrip_selftest; "
+      "sys.exit(schema_roundtrip_selftest(verbose=True))"]),
 ]
 
 

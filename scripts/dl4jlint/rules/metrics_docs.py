@@ -28,8 +28,8 @@ from scripts.dl4jlint.core import (
 _METHODS = {"counter", "gauge", "histogram"}
 DOCS = os.path.join(REPO, "docs", "observability.md")
 
-# (rel path, line, has_help) per family
-Registration = Tuple[str, int, bool]
+# (rel path, line, has_help, normalized help text or None) per family
+Registration = Tuple[str, int, bool, Optional[str]]
 
 
 def _literal_str(node) -> Optional[str]:
@@ -62,16 +62,27 @@ def registrations_in(tree: ast.Module,
             name = consts.get(arg0.id)
         if not name or not name.startswith("dl4j_"):
             continue
+        def _resolve(n) -> Optional[str]:
+            # literal, or a module-level string constant (families whose
+            # help must be IDENTICAL across registration sites share a
+            # _H_* constant — see the drift check in finalize)
+            s = _literal_str(n)
+            if s is None and isinstance(n, ast.Name):
+                s = consts.get(n.id)
+            return s
+
         help_text = None
         if len(node.args) > 1:
-            help_text = _literal_str(node.args[1])
+            help_text = _resolve(node.args[1])
         for kw in node.keywords:
             if kw.arg == "help":
-                help_text = _literal_str(kw.value)
+                help_text = _resolve(kw.value)
         # adjacent string literals concatenate into one Constant, so a
         # multi-line help renders as a single (truthy) literal here
         has_help = bool(help_text and help_text.strip())
-        out.setdefault(name, []).append((rel, node.lineno, has_help))
+        # whitespace-normalized so a re-wrap is not "drift"
+        norm = " ".join(help_text.split()) if has_help else None
+        out.setdefault(name, []).append((rel, node.lineno, has_help, norm))
     return out
 
 
@@ -91,8 +102,9 @@ def documented_families(docs_path: str = DOCS) -> Set[str]:
 
 class MetricsDocsRule(Rule):
     name = "metrics-docs"
-    description = ("registered dl4j_* metric family lacks help text or a "
-                   "docs/observability.md table row")
+    description = ("registered dl4j_* metric family lacks help text, a "
+                   "docs/observability.md table row, or registers with "
+                   "diverging help text across modules")
 
     def __init__(self, docs_path: str = DOCS):
         self.docs_path = docs_path
@@ -115,9 +127,9 @@ class MetricsDocsRule(Rule):
         docs = (documented_families(self.docs_path)
                 if os.path.exists(self.docs_path) else set())
         for name, sites in sorted(regs.items()):
-            path, line, _ = sites[0]
-            if not any(h for _f, _l, h in sites):
-                where = ", ".join(f"{f}:{l}" for f, l, _ in sites[:3])
+            path, line = sites[0][0], sites[0][1]
+            if not any(h for _f, _l, h, _t in sites):
+                where = ", ".join(f"{f}:{l}" for f, l, _h, _t in sites[:3])
                 findings.append(Finding(
                     self.name, path, line, name,
                     f"{name}: registered without non-empty help text "
@@ -127,4 +139,20 @@ class MetricsDocsRule(Rule):
                     self.name, path, line, name,
                     f"{name}: no row in docs/observability.md metric "
                     f"table"))
+            # diverging help across modules breaks the federated # HELP
+            # line: the aggregator re-exports ONE help string per
+            # family, so two owners must agree word-for-word
+            helps: Dict[str, Tuple[str, int]] = {}
+            for f, l, _h, text in sites:
+                if text is not None and text not in helps:
+                    helps[text] = (f, l)
+            if (len(helps) > 1
+                    and len({f for f, _l in helps.values()}) > 1):
+                where = ", ".join(
+                    f"{f}:{l}" for f, l in sorted(helps.values())[:3])
+                findings.append(Finding(
+                    self.name, path, line, name,
+                    f"{name}: help text diverges across modules "
+                    f"({where}) — the federated HELP line needs one "
+                    f"agreed string"))
         return findings

@@ -107,6 +107,13 @@ def pytest_configure(config):
         "round-trips, cache-aware admission, invalidation-on-swap, and "
         "the seeded cache-invariant fuzzer "
         "(python -m pytest -m prefix_cache)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet telemetry plane tests — cross-process metrics "
+        "federation (schema-versioned snapshots, epoch/seq delta merge, "
+        "staleness), decode SLO attribution (TTFT/ITL/goodput, phase "
+        "breakdown), and the router-facing cache stats surface "
+        "(python -m pytest -m fleet)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,18 @@
+"""Fixture: one dl4j_* family registered in two 'modules' with
+DIVERGING help text (metrics-docs drift finding).
+
+The rule keys drift on distinct source FILES, so this file pairs with
+``metrics_docs_drift_bad2.py`` — both register
+``dl4j_fixture_drift_total`` with different help strings.  The family
+name is fixture-only so the repo-wide lint never sees it registered in
+the package (both registrations live under tests/lint_fixtures, which
+the corpus scan skips).
+"""
+
+from deeplearning4j_tpu.observability.metrics import get_registry
+
+
+def register():
+    get_registry().counter(
+        "dl4j_fixture_drift_total",
+        "Requests served by the fixture engine")

@@ -1,0 +1,10 @@
+"""Second module of the drift fixture pair — same family, different
+help text (see ``metrics_docs_drift_bad.py``)."""
+
+from deeplearning4j_tpu.observability.metrics import get_registry
+
+
+def register():
+    get_registry().counter(
+        "dl4j_fixture_drift_total",
+        "Fixture requests, by outcome")

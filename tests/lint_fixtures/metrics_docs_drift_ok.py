@@ -1,0 +1,18 @@
+"""Fixture: the same family registered in two call sites with help
+text that differs only in whitespace/wrapping — NOT drift (the rule
+normalizes whitespace before comparing)."""
+
+from deeplearning4j_tpu.observability.metrics import get_registry
+
+
+def register_a():
+    get_registry().counter(
+        "dl4j_fixture_drift_total",
+        "Requests served by the fixture engine")
+
+
+def register_b():
+    get_registry().counter(
+        "dl4j_fixture_drift_total",
+        "Requests served "
+        "by the fixture engine")

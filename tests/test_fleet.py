@@ -1,0 +1,457 @@
+"""Fleet telemetry plane (`deeplearning4j_tpu/observability/fleet.py`).
+
+Acceptance oracles from the PR issue:
+
+- schema-versioned snapshots: bounded, JSON-safe, deterministic wire
+  form; NaN gauges map to null instead of tripping the strict encoder;
+- epoch/seq delta merge: counter/histogram totals accumulate across
+  snapshots without double-counting replays, a restarted publisher
+  (new epoch) RESUMES merging — no double-count, no reset-to-zero;
+- staleness: a worker that stops publishing flips stale within
+  ``expire_after_s``, its gauges drop from the fleet view while its
+  monotonic counters survive, and fleet health NAMES it;
+- forward compatibility: unparseable/foreign-schema/malformed input is
+  counted and skipped, never raised;
+- decode SLO attribution: TTFT/ITL attainment + goodput math, the
+  engine's per-phase breakdown reconciling with its busy wall, the ITL
+  histogram populating under real decode, and the /generate access log
+  carrying the per-request SLO verdict;
+- the router-facing cache stats surface: prefix-cache stats ride the
+  federated snapshot with the tree version tag, and a hot-swap
+  invalidation is visible THROUGH the aggregator within one publish.
+"""
+
+import json
+import logging
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import http.client
+
+from deeplearning4j_tpu.generation import GenerationEngine
+from deeplearning4j_tpu.models.zoo import transformer_char_lm
+from deeplearning4j_tpu.observability.fleet import (
+    SCHEMA_VERSION, FleetAggregator, SLOTracker, TelemetryPublisher,
+    schema_roundtrip_selftest,
+)
+from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+from deeplearning4j_tpu.streaming import MessageBroker
+
+pytestmark = pytest.mark.fleet
+
+VOCAB = 29
+
+
+def small_lm(seed=12345):
+    return transformer_char_lm(vocab_size=VOCAB, d_model=32, n_heads=4,
+                               layers=2, max_cache=128, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng = GenerationEngine(small_lm(), slots=4, page_size=4,
+                           max_context=32, max_queue=64, deadline_s=30.0,
+                           prefix_cache=True)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def wait_for(cond, timeout=10.0, poll=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return True
+        time.sleep(poll)
+    return False
+
+
+def worker_registry():
+    """A publisher-side registry with one family of each kind."""
+    reg = MetricsRegistry()
+    c = reg.counter("dl4j_test_work_total", "Work items processed",
+                    labels=("kind",))
+    g = reg.gauge("dl4j_test_depth", "Queue depth right now")
+    h = reg.histogram("dl4j_test_lat_seconds", "Observed latencies",
+                      buckets=(0.01, 0.1, 1.0))
+    return reg, c, g, h
+
+
+def fleet_value(agg, name, worker):
+    """Merged value for one worker's unlabeled-or-first sample of a
+    family in the rebuilt fleet registry (None = absent)."""
+    for fam in agg.registry().families():
+        if fam.name != name:
+            continue
+        total, seen = 0.0, False
+        for label_pairs, child in fam.samples():
+            labels = dict(label_pairs)
+            if labels.get("worker", labels.get("origin")) == worker:
+                seen = True
+                total += (child.snapshot()["count"]
+                          if fam.kind == "histogram" else child.value)
+        return total if seen else None
+    return None
+
+
+def wire(worker="w1", epoch="e1", seq=1, families=None, **extra):
+    snap = {"schema": SCHEMA_VERSION, "worker": worker, "epoch": epoch,
+            "seq": seq, "ts": time.time(), "families": families or {}}
+    snap.update(extra)
+    return json.dumps(snap)
+
+
+def counter_fam(value):
+    return {"kind": "counter", "help": "h", "label_names": [],
+            "samples": [{"labels": {}, "value": value}]}
+
+
+# ------------------------------------------------------------ SLO tracker
+def test_slo_tracker_attainment_math():
+    reg = MetricsRegistry()
+    t = SLOTracker(ttft_target_s=0.1, itl_target_s=0.05,
+                   goodput_window_s=10.0, registry=reg, engine_id="e0")
+    # good: fast TTFT, fast ITL
+    assert t.observe_request(ttft_s=0.05, itl_s=[0.01] * 20, now=100.0)
+    # TTFT miss
+    assert not t.observe_request(ttft_s=0.2, itl_s=[0.01], now=100.2)
+    # ITL p95 miss (every gap slow)
+    assert not t.observe_request(ttft_s=0.05, itl_s=[0.2] * 10, now=100.4)
+    # failed request is never good, even with fast latencies
+    assert not t.observe_request(ttft_s=0.05, itl_s=[0.01],
+                                 completed=False, now=100.6)
+    # no inter-token gaps: the ITL leg passes vacuously
+    assert t.observe_request(ttft_s=0.05, itl_s=[], now=100.8)
+    d = t.as_dict()
+    assert d["finished"] == 5
+    assert d["ttft_attainment"] == pytest.approx(4 / 5)
+    assert d["itl_attainment"] == pytest.approx(4 / 5)
+    assert d["good_attainment"] == pytest.approx(2 / 5)
+    assert d["targets"] == {"ttft_s": 0.1, "itl_p95_s": 0.05,
+                            "goodput_window_s": 10.0}
+    # the registry mirrors the attainment as lazy gauges
+    text = reg.to_prometheus()
+    assert "dl4j_decode_slo_attainment" in text
+    assert "dl4j_decode_goodput_rps" in text
+
+
+def test_slo_tracker_goodput_window_slides():
+    t = SLOTracker(ttft_target_s=1.0, itl_target_s=1.0,
+                   goodput_window_s=10.0, registry=MetricsRegistry())
+    for i in range(4):
+        t.observe_request(ttft_s=0.1, now=100.0 + i)
+    assert t.goodput_rps(now=104.0) == pytest.approx(4 / 10.0)
+    # two of the four age out of the window
+    assert t.goodput_rps(now=111.5) == pytest.approx(2 / 10.0)
+    # all gone
+    assert t.goodput_rps(now=1000.0) == 0.0
+
+
+# ------------------------------------------------- snapshot schema + wire
+def test_snapshot_schema_and_bounds():
+    reg, c, g, h = worker_registry()
+    c.inc(3, kind="a")
+    g.set(7.5)
+    h.observe(0.05)
+    pub = TelemetryPublisher(
+        "w1", registry=reg,
+        state_fn=lambda: {"scheduler": {"queued": 2}},
+        prefix_cache=lambda: {"version": "default@v1", "hits": 4})
+    snap = pub.snapshot()
+    assert snap["schema"] == SCHEMA_VERSION
+    assert snap["worker"] == "w1" and snap["seq"] == 1
+    assert snap["epoch"] and snap["ts"] > 0
+    fams = snap["families"]
+    assert fams["dl4j_test_work_total"]["kind"] == "counter"
+    assert fams["dl4j_test_work_total"]["samples"][0] == {
+        "labels": {"kind": "a"}, "value": 3.0}
+    assert fams["dl4j_test_depth"]["samples"][0]["value"] == 7.5
+    hist = fams["dl4j_test_lat_seconds"]
+    assert hist["buckets"] == [0.01, 0.1, 1.0]
+    assert hist["samples"][0]["count"] == 1
+    assert snap["state"] == {"scheduler": {"queued": 2}}
+    assert snap["prefix_cache"]["version"] == "default@v1"
+    # seq advances per snapshot within one epoch
+    assert pub.snapshot()["seq"] == 2
+
+
+def test_snapshot_nan_gauge_serializes_to_null():
+    reg = MetricsRegistry()
+    reg.gauge("dl4j_test_depth", "Queue depth right now").set(float("nan"))
+    pub = TelemetryPublisher("w1", registry=reg)
+    payload = pub.serialize()   # allow_nan=False: must not raise
+    fams = json.loads(payload)["families"]
+    assert fams["dl4j_test_depth"]["samples"][0]["value"] is None
+
+
+def test_snapshot_bounds_sample_explosion():
+    reg = MetricsRegistry()
+    c = reg.counter("dl4j_test_work_total", "Work items processed",
+                    labels=("kind",))
+    for i in range(40):
+        c.inc(kind=f"k{i:03d}")
+    pub = TelemetryPublisher("w1", registry=reg,
+                             max_samples_per_family=16)
+    snap = pub.snapshot()
+    assert len(snap["families"]["dl4j_test_work_total"]["samples"]) == 16
+    assert snap["truncated_samples"] == 24
+
+
+def test_schema_roundtrip_selftest_green():
+    assert schema_roundtrip_selftest() == 0
+
+
+# ------------------------------------------------------ delta/epoch merge
+def test_counter_delta_merge_ignores_replays():
+    agg = FleetAggregator(registry=MetricsRegistry())
+    assert agg.ingest(wire(seq=1, families={
+        "dl4j_test_work_total": counter_fam(10)}))
+    assert agg.ingest(wire(seq=2, families={
+        "dl4j_test_work_total": counter_fam(25)}))
+    assert fleet_value(agg, "dl4j_test_work_total", "w1") == 25.0
+    # replay of seq 2 and an out-of-order seq 1 both drop
+    assert not agg.ingest(wire(seq=2, families={
+        "dl4j_test_work_total": counter_fam(25)}))
+    assert not agg.ingest(wire(seq=1, families={
+        "dl4j_test_work_total": counter_fam(10)}))
+    assert fleet_value(agg, "dl4j_test_work_total", "w1") == 25.0
+    assert agg.fleet_table()["merge_skips"].get("replay") == 2
+
+
+def test_epoch_restart_resumes_without_double_count():
+    agg = FleetAggregator(registry=MetricsRegistry())
+    agg.ingest(wire(epoch="e1", seq=1, families={
+        "dl4j_test_work_total": counter_fam(10)}))
+    agg.ingest(wire(epoch="e1", seq=2, families={
+        "dl4j_test_work_total": counter_fam(25)}))
+    # restart: new epoch re-counts from a fresh base (5), history stays
+    agg.ingest(wire(epoch="e2", seq=1, families={
+        "dl4j_test_work_total": counter_fam(5)}))
+    assert fleet_value(agg, "dl4j_test_work_total", "w1") == 30.0
+    # and the new epoch keeps delta-merging
+    agg.ingest(wire(epoch="e2", seq=2, families={
+        "dl4j_test_work_total": counter_fam(9)}))
+    assert fleet_value(agg, "dl4j_test_work_total", "w1") == 34.0
+
+
+def test_histogram_delta_merge_across_snapshots():
+    agg = FleetAggregator(registry=MetricsRegistry())
+
+    def hist_fam(count, total, counts):
+        return {"kind": "histogram", "help": "h", "label_names": [],
+                "buckets": [0.1, 1.0],
+                "samples": [{"labels": {}, "count": count, "sum": total,
+                             "min": 0.01, "max": 0.5,
+                             "bucket_counts": counts}]}
+
+    agg.ingest(wire(seq=1, families={
+        "dl4j_test_lat_seconds": hist_fam(5, 0.5, [2, 3])}))
+    agg.ingest(wire(seq=2, families={
+        "dl4j_test_lat_seconds": hist_fam(8, 0.9, [3, 5])}))
+    assert fleet_value(agg, "dl4j_test_lat_seconds", "w1") == 8
+
+
+# ----------------------------------------------------------- staleness
+def test_stale_worker_drops_gauges_keeps_counters_and_is_named():
+    agg = FleetAggregator(expire_after_s=0.2, registry=MetricsRegistry())
+    agg.ingest(wire(families={
+        "dl4j_test_work_total": counter_fam(12),
+        "dl4j_test_depth": {"kind": "gauge", "help": "h",
+                            "label_names": [],
+                            "samples": [{"labels": {}, "value": 4.0}]},
+    }))
+    assert fleet_value(agg, "dl4j_test_depth", "w1") == 4.0
+    assert agg.workers()[0]["stale"] is False
+    assert agg.evaluate_health().healthy
+    time.sleep(0.35)
+    # flipped stale: gauges vanish from the fleet view, counters survive
+    assert agg.workers()[0]["stale"] is True
+    assert fleet_value(agg, "dl4j_test_depth", "w1") is None
+    assert fleet_value(agg, "dl4j_test_work_total", "w1") == 12.0
+    verdict = agg.evaluate_health()
+    assert not verdict.healthy
+    assert any("w1" in str(r) for r in verdict.results if not r["ok"])
+    # the fleet meta gauges agree
+    text = agg.registry().to_prometheus()
+    assert "dl4j_fleet_stale_workers 1" in text
+    assert "dl4j_fleet_workers 0" in text
+
+
+# ---------------------------------------------------- federation transport
+def test_two_publishers_one_aggregator_over_broker():
+    broker = MessageBroker()
+    agg = FleetAggregator(broker=broker, topic="t.fleet",
+                          registry=MetricsRegistry()).start()
+    try:
+        regs = []
+        for i, wid in enumerate(("w1", "w2")):
+            reg, c, g, _h = worker_registry()
+            c.inc(10 * (i + 1), kind="x")
+            g.set(float(i))
+            regs.append(TelemetryPublisher(wid, broker=broker,
+                                           topic="t.fleet", registry=reg))
+        for pub in regs:
+            assert pub.publish_once() == 1   # one subscriber: the agg
+        assert wait_for(lambda: len(agg.workers()) == 2)
+        table = agg.fleet_table()
+        assert [w["worker"] for w in table["workers"]] == ["w1", "w2"]
+        assert fleet_value(agg, "dl4j_test_work_total", "w1") == 10.0
+        assert fleet_value(agg, "dl4j_test_work_total", "w2") == 20.0
+        text = agg.registry().to_prometheus()
+        assert 'worker="w1"' in text and 'worker="w2"' in text
+        assert "dl4j_fleet_workers 2" in text
+    finally:
+        agg.stop()
+
+
+def test_http_federation_and_fleet_endpoints():
+    broker = MessageBroker()
+    bport = broker.serve(port=0)
+    url = f"http://127.0.0.1:{bport}"
+    agg = FleetAggregator(url=url, topic="t.http",
+                          registry=MetricsRegistry()).start()
+    try:
+        time.sleep(0.3)   # first long-poll registers the subscription
+        reg, c, _g, _h = worker_registry()
+        c.inc(6, kind="x")
+        pub = TelemetryPublisher("w1", url=url, topic="t.http",
+                                 registry=reg)
+        assert wait_for(lambda: pub.publish_once() >= 1 and
+                        len(agg.workers()) == 1)
+        fport = agg.serve(port=0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fport}/metrics") as resp:
+            text = resp.read().decode()
+        assert 'dl4j_test_work_total{kind="x",worker="w1"} 6' in text
+        assert "dl4j_fleet_workers 1" in text
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fport}/fleet") as resp:
+            table = json.loads(resp.read())
+        assert table["workers"][0]["worker"] == "w1"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{fport}/health") as resp:
+            assert json.loads(resp.read())["healthy"] is True
+    finally:
+        agg.stop()
+        broker.stop()
+
+
+# ------------------------------------------------- forward compatibility
+def test_ingest_never_raises_on_garbage():
+    agg = FleetAggregator(registry=MetricsRegistry())
+    assert not agg.ingest("{not json")
+    assert not agg.ingest(json.dumps([1, 2, 3]))
+    assert not agg.ingest(wire(schema=99))          # foreign schema
+    assert not agg.ingest(json.dumps({"schema": SCHEMA_VERSION}))  # no id
+    # malformed family fragments are skipped, the snapshot still lands
+    assert agg.ingest(wire(seq=1, families={
+        "dl4j_bad": "not-a-dict",
+        "dl4j_weird": {"kind": "thermometer", "samples": []},
+        "dl4j_test_work_total": counter_fam(3),
+    }, some_future_field={"ok": True}))
+    assert fleet_value(agg, "dl4j_test_work_total", "w1") == 3.0
+    skips = agg.fleet_table()["merge_skips"]
+    assert skips.get("parse") == 2
+    assert skips.get("schema") == 1
+    assert skips.get("fields") == 1
+
+
+# ------------------------------------------- decode SLO attribution (e2e)
+def test_engine_decode_slo_attribution(engine):
+    rs = np.random.RandomState(7)
+    for _ in range(4):
+        h = engine.submit(rs.randint(0, VOCAB, 6).tolist(), 8)
+        assert len(h.result(timeout=60)) == 8
+        assert h.slo_ok is not None        # settled by the SLO tracker
+    st = engine.stats()
+    slo = st["slo"]
+    assert slo["finished"] >= 4
+    assert slo["good_attainment"] is not None
+    assert slo["goodput_rps"] >= 0.0
+    # every decode-loop phase fired, and the breakdown reconciles with
+    # the loop's busy wall (phases nest inside it, so sum <= busy + eps)
+    phases = st["phases"]["phases"]
+    for name in ("schedule", "page_gather", "jitted_step",
+                 "sample_harvest", "stream_write"):
+        assert phases[name]["count"] > 0, name
+    phase_ms = sum(p["total_ms"] for p in phases.values())
+    assert phase_ms <= st["busy_wall_s"] * 1e3 * 1.1 + 5.0
+    assert phase_ms > 0
+    # the ITL histogram populated under real decode
+    itl = sum(child.snapshot()["count"] for _l, child
+              in engine.metrics.inter_token.samples())
+    assert itl > 0
+
+
+def test_generate_access_log_carries_slo_fields(caplog):
+    from deeplearning4j_tpu.models.sequential import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.streaming.serving import InferenceServer
+
+    conf = (NeuralNetConfiguration.builder().seed(1)
+            .updater("sgd", learning_rate=0.1).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3, loss="mcxent",
+                               activation="softmax")).build())
+    pred = MultiLayerNetwork(conf).init()
+    gen = GenerationEngine(small_lm(), slots=2, page_size=4,
+                           max_context=16, max_queue=8,
+                           prefill_buckets=(8,)).start()
+    srv = InferenceServer(pred, generation=gen, access_log=True)
+    port = srv.start()
+    try:
+        with caplog.at_level(logging.INFO,
+                             logger="deeplearning4j_tpu.serving.access"):
+            c = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            c.request("POST", "/generate", json.dumps(
+                {"prompt": [1, 2, 3], "max_tokens": 5}),
+                {"X-Request-Id": "slo-trace-1"})
+            r = c.getresponse()
+            assert r.status == 200
+            r.read()
+        lines = [json.loads(rec.message) for rec in caplog.records
+                 if rec.name == "deeplearning4j_tpu.serving.access"]
+        line = next(l for l in lines if l["trace_id"] == "slo-trace-1")
+        assert line["tokens"] == 5
+        assert line["slo_ok"] in (True, False)
+        # 5 tokens -> 4 inter-token gaps -> a real p50
+        assert line["itl_p50_ms"] is not None and line["itl_p50_ms"] >= 0
+    finally:
+        srv.stop()
+        gen.stop()
+
+
+# ------------------------------- router-facing cache stats + hot swap
+def test_prefix_stats_federate_and_hotswap_is_visible(engine):
+    broker = MessageBroker()
+    agg = FleetAggregator(broker=broker, topic="t.swap",
+                          registry=MetricsRegistry()).start()
+    pub = engine.fleet_publisher("w-eng", broker=broker, topic="t.swap")
+    try:
+        engine.submit([1, 2, 3, 4, 5, 6], 4).result(timeout=60)
+        assert pub.publish_once() == 1
+        assert wait_for(lambda: len(agg.workers()) == 1)
+        row = agg.workers()[0]
+        # the router-facing surface, exactly as the worker published it
+        pc = row["prefix_cache"]
+        for key in ("version", "resident_pages", "pinned_pages",
+                    "host_tier_bytes", "hit_rate"):
+            assert key in pc, key
+        v1 = pc["version"]
+        assert row["slo"]["finished"] >= 1
+        assert row["state"]["scheduler"]
+        # hot swap: the tree version tag must change THROUGH the
+        # aggregator within one publish interval (the decode loop stamps
+        # the tree on its next idle tick, then the publish carries it)
+        engine.deploy("default", small_lm(seed=777))
+        assert wait_for(lambda: engine.prefix_cache.version != v1)
+        assert pub.publish_once() == 1
+        assert wait_for(lambda: len(agg.workers()) == 1 and
+                        agg.workers()[0]["prefix_cache"]["version"] != v1)
+        assert agg.workers()[0]["prefix_cache"]["version"] != v1
+    finally:
+        agg.stop()

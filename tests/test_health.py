@@ -152,6 +152,59 @@ def test_cluster_aggregator_merges_plain_dicts():
     assert ClusterStatsAggregator.merge([])["workers"] == 0
 
 
+def test_cluster_aggregator_merge_forward_compat():
+    """Mixed-version fleets: snapshots with missing keys, unknown extra
+    keys, or a different schema version are log-and-skip (or tolerated),
+    NEVER raised — a v2 worker must not take down a v1 aggregator."""
+    ok = {"worker": "good", "count": 3, "mean": 0.02,
+          "samples": [0.02] * 3, "samples_per_second": 50.0}
+    # unknown extra keys from a newer publisher are simply ignored
+    newer = dict(ok, worker="newer", mean=0.04,
+                 some_v2_field={"nested": True}, zstd_dict=b"\x00")
+    view = ClusterStatsAggregator.merge([ok, newer])
+    assert view["workers"] == 2
+    assert view["slowest_worker"] == "newer"
+    # a mismatched schema version is skipped, not merged, not raised
+    alien = dict(ok, worker="alien", schema=99)
+    view = ClusterStatsAggregator.merge([ok, alien])
+    assert view["workers"] == 1
+    # a matching explicit schema tag still merges
+    tagged = dict(ok, worker="tagged",
+                  schema=ClusterStatsAggregator.SNAPSHOT_SCHEMA)
+    assert ClusterStatsAggregator.merge([ok, tagged])["workers"] == 2
+
+
+def test_cluster_aggregator_merge_never_raises_on_garbage():
+    """Every malformed shape the wire could produce: wrong types, junk
+    counts, non-numeric samples — merged output stays well-formed."""
+    ok = {"worker": "good", "count": 2, "mean": 0.01,
+          "samples": [0.01, 0.01], "samples_per_second": 10.0}
+    garbage = [
+        None, {}, "not-a-dict", 42, [],
+        {"worker": "no-count"},
+        {"worker": "zero", "count": 0},
+        {"worker": "bool-count", "count": True},
+        {"worker": "str-count", "count": "three"},
+        {"worker": "bad-mean", "count": 2, "mean": "fast"},
+        {"worker": "bad-samples", "count": 2, "mean": 0.01,
+         "samples": "oops"},
+        {"worker": "mixed-samples", "count": 2, "mean": 0.01,
+         "samples": [0.01, "nan-ish", None, True]},
+        {"worker": "bad-sps", "count": 2, "mean": 0.01,
+         "samples_per_second": {"rate": 1}},
+    ]
+    view = ClusterStatsAggregator.merge([ok] + garbage)
+    # the one usable snapshot plus the count-bearing degraded ones merge;
+    # nothing raises and the summary stays numeric
+    assert view["slowest_worker"] == "good"
+    assert view["steps"] >= 2
+    assert isinstance(view["samples_per_second_total"], float)
+    for k in ("mean", "p50", "max"):
+        assert isinstance(view["step_seconds"][k], float)
+    # all-garbage input degrades to the empty view
+    assert ClusterStatsAggregator.merge(garbage[:5])["workers"] == 0
+
+
 def test_cluster_aggregator_from_registry(fresh_telemetry):
     wt = WorkerTelemetry("regview", min_steps=2)
     for _ in range(5):

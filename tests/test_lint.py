@@ -123,6 +123,24 @@ def test_metrics_docs_rule():
     assert all(f.symbol == "dl4j_fixture_only_total" for f in found)
 
 
+def test_metrics_docs_help_drift_rule():
+    """One dl4j_* family registered in two modules with diverging help
+    text is flagged (federated HELP lines need one agreed string);
+    whitespace-only rewraps inside one module are not drift."""
+    bad = lint(["metrics_docs_drift_bad.py", "metrics_docs_drift_bad2.py"],
+               ("metrics-docs",))
+    drift = [f for f in bad if "diverges" in f.message]
+    assert drift, "no drift finding for diverging help across modules"
+    assert all(f.symbol == "dl4j_fixture_drift_total" for f in drift)
+    # each drift-bad file alone has ONE help string -> no drift finding
+    solo = lint(["metrics_docs_drift_bad.py"], ("metrics-docs",))
+    assert not any("diverges" in f.message for f in solo)
+    ok = lint(["metrics_docs_drift_ok.py"], ("metrics-docs",))
+    assert not any("diverges" in f.message for f in ok), (
+        "whitespace rewrap flagged as drift: "
+        + "; ".join(f.format() for f in ok))
+
+
 def test_rule_registry_complete():
     names = {r.name for r in ALL_RULES}
     assert names == {"host-sync-in-hot-path", "recompile-hazard",

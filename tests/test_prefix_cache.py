@@ -320,6 +320,65 @@ def test_double_unpin_raises_after_invalidate():
 
 
 # ------------------------------------------------------------- seeded fuzzer
+def test_stats_consistent_under_concurrent_eviction():
+    """``GET /generation/cache`` and the fleet snapshot read
+    prefix-cache stats through ``stats()``, which owns the tree lock —
+    a stats walk racing admit/offload/invalidate churn must never
+    report torn numbers (e.g. a node's host slice set but
+    ``host_tier_bytes`` not yet bumped)."""
+    rng = np.random.RandomState(20260807)
+    cache, pc, tp = _mk(num_pages=13, page_size=4, pages_per_slot=8,
+                        budget=3 * 512)   # tiny host tier: evicts + drops
+    families = [list(rng.randint(0, 50, 16)) for _ in range(4)]
+    stop = threading.Event()
+    torn = []
+
+    def reader():
+        while not stop.is_set():
+            st = pc.stats()
+            # every pair below is updated together under the lock, so
+            # any mismatch inside ONE returned dict is a torn read
+            if st["host_tier_bytes"] != st["host_pages"] * tp.page_bytes():
+                torn.append(("host_tier", st))
+            if (st["resident_pages"] > st["nodes"]
+                    or st["pinned_pages"] > st["nodes"]):
+                torn.append(("pages_vs_nodes", st))
+            total = st["hits"] + st["misses"]
+            expect = round(st["hits"] / total, 4) if total else 0.0
+            if st["hit_rate"] != expect:
+                torn.append(("hit_rate", st))
+
+    readers = [threading.Thread(target=reader, daemon=True)
+               for _ in range(3)]
+    for t in readers:
+        t.start()
+    inflight = []
+    try:
+        for step in range(300):
+            op = rng.randint(0, 8)
+            if op <= 4:
+                fam = families[rng.randint(len(families))]
+                prompt = fam[:int(rng.randint(5, len(fam) + 1))]
+                try:
+                    res = pc.admit(prompt, int(rng.randint(1, 6)))
+                except PageExhaustedError:
+                    continue
+                _stamp_fresh(pc, tp, prompt, res)
+                inflight.append(res)
+            elif op <= 6 and inflight:
+                cache.free(inflight.pop(
+                    rng.randint(len(inflight))).pages)
+            elif rng.random_sample() < 0.2:
+                pc.invalidate("pool_reset")
+    finally:
+        stop.set()
+        for t in readers:
+            t.join(timeout=10.0)
+    assert torn == [], f"torn stats snapshots: {torn[:3]}"
+    # the churn must actually have exercised eviction/offload paths
+    assert pc.offload_total > 0 or pc.evictions
+
+
 def test_cache_invariant_fuzz():
     """Randomized admit/release/pin/unpin/invalidate churn, checked
     step-by-step against a model-checker dict: chain-stamped content on
