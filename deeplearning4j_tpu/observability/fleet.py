@@ -35,8 +35,9 @@ reset-to-zero artifact.  Histograms merge the same way on their
 (count, bucket_counts) arrays.
 
 This module must stay importable without jax or numpy: the CI schema
-round-trip gate (``scripts/check_fleet_schema.py``) loads it in a
-subprocess where heavyweight imports would swamp the check.
+round-trip gate (``scripts/ci_checks.py`` runs
+``schema_roundtrip_selftest`` in a bare subprocess) loads it where
+heavyweight imports would swamp the check.
 """
 
 from __future__ import annotations
@@ -562,14 +563,26 @@ class FleetAggregator:
             return False
         epoch = str(snap.get("epoch") or "")
         seq_n = _num(snap.get("seq"))
-        seq = int(seq_n) if seq_n is not None else 0
+        if seq_n is None:
+            # defaulting would pin the worker at seq 0 and drop every
+            # later same-epoch snapshot as a replay
+            self._skip("fields",
+                       f"snapshot from {worker!r} without a numeric seq")
+            return False
+        seq = int(seq_n)
         now = time.monotonic()
+        # skips found under the lock are emitted after release: _skip
+        # re-acquires self._lock, so calling it here would deadlock
+        pending_skips: List[Tuple[str, str]] = []
         with self._lock:
             ws = self._workers.get(worker)
             if ws is None:
                 ws = self._workers[worker] = _WorkerView(worker)
             if epoch == ws.epoch and seq <= ws.seq:
                 replay = True
+                pending_skips.append(
+                    ("replay", f"worker {worker} epoch {epoch} seq "
+                               f"{seq} <= {ws.seq}"))
             else:
                 replay = False
                 new_epoch = epoch != ws.epoch
@@ -584,9 +597,9 @@ class FleetAggregator:
                         try:
                             self._merge_family(ws, str(name), fd)
                         except Exception as e:
-                            self._skip("family",
-                                       f"family {name!r} from "
-                                       f"{worker}: {e!r}")
+                            pending_skips.append(
+                                ("family", f"family {name!r} from "
+                                           f"{worker}: {e!r}"))
                 ws.epoch, ws.seq = epoch, seq
                 ws.last_recv = now
                 ws.snapshots += 1
@@ -595,10 +608,9 @@ class FleetAggregator:
                 for attr in ("health", "state", "prefix_cache", "slo"):
                     val = snap.get(attr)
                     setattr(ws, attr, val if isinstance(val, dict) else None)
+        for reason, detail in pending_skips:
+            self._skip(reason, detail)
         if replay:
-            self._skip("replay",
-                       f"worker {worker} epoch {epoch} seq {seq} <= "
-                       f"{ws.seq}")
             return False
         self._m_snapshots.inc(worker=worker)
         if ws.last_ts is not None:
@@ -634,19 +646,32 @@ class FleetAggregator:
         samples = fd.get("samples")
         if not isinstance(samples, list):
             return
+        if kind == "gauge":
+            # snapshots carry full gauge state: replace the family's
+            # book wholesale so label-sets that stop appearing (e.g.
+            # truncated away) don't stay frozen at their last value
+            book: Dict[Tuple[str, ...], float] = {}
+            for s in samples:
+                if not isinstance(s, dict):
+                    continue
+                labels = s.get("labels")
+                labels = labels if isinstance(labels, dict) else {}
+                key = tuple(str(labels.get(k, "")) for k in label_names)
+                v = _num(s.get("value"))
+                if v is not None:
+                    book[key] = v
+            if book:
+                ws.gauges[name] = book
+            else:
+                ws.gauges.pop(name, None)
+            return
         for s in samples:
             if not isinstance(s, dict):
                 continue
             labels = s.get("labels")
             labels = labels if isinstance(labels, dict) else {}
             key = tuple(str(labels.get(k, "")) for k in label_names)
-            if kind == "gauge":
-                v = _num(s.get("value"))
-                if v is None:
-                    ws.gauges.get(name, {}).pop(key, None)
-                else:
-                    ws.gauges.setdefault(name, {})[key] = v
-            elif kind == "counter":
+            if kind == "counter":
                 v = _num(s.get("value"))
                 if v is None or v < 0:
                     continue

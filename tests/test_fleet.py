@@ -346,17 +346,55 @@ def test_ingest_never_raises_on_garbage():
     assert not agg.ingest(json.dumps([1, 2, 3]))
     assert not agg.ingest(wire(schema=99))          # foreign schema
     assert not agg.ingest(json.dumps({"schema": SCHEMA_VERSION}))  # no id
-    # malformed family fragments are skipped, the snapshot still lands
+    # a missing/non-numeric seq is dropped, NOT defaulted to 0 (which
+    # would pin the worker and replay-drop all its later snapshots)
+    snap = json.loads(wire())
+    del snap["seq"]
+    assert not agg.ingest(json.dumps(snap))
+    assert not agg.ingest(wire(seq="soon"))
+    # malformed family fragments are skipped, the snapshot still lands —
+    # including fragments that RAISE mid-merge (non-iterable
+    # label_names), which must not wedge the aggregator's lock
     assert agg.ingest(wire(seq=1, families={
         "dl4j_bad": "not-a-dict",
         "dl4j_weird": {"kind": "thermometer", "samples": []},
+        "dl4j_explodes": {"kind": "gauge", "label_names": 5,
+                          "samples": [{"labels": {}, "value": 1.0}]},
         "dl4j_test_work_total": counter_fam(3),
     }, some_future_field={"ok": True}))
     assert fleet_value(agg, "dl4j_test_work_total", "w1") == 3.0
+    # the lock was released cleanly: later snapshots keep merging
+    assert agg.ingest(wire(seq=2, families={
+        "dl4j_test_work_total": counter_fam(7)}))
+    assert fleet_value(agg, "dl4j_test_work_total", "w1") == 7.0
     skips = agg.fleet_table()["merge_skips"]
     assert skips.get("parse") == 2
     assert skips.get("schema") == 1
-    assert skips.get("fields") == 1
+    assert skips.get("fields") == 3
+    assert skips.get("family") == 1
+
+
+def test_vanished_gauge_labelset_drops_from_fleet_view():
+    """A gauge label-set absent from the next snapshot (truncated away,
+    or simply gone) must leave the fleet view, not stay frozen."""
+    agg = FleetAggregator(registry=MetricsRegistry())
+
+    def depth_fam(samples):
+        return {"kind": "gauge", "help": "h", "label_names": ["q"],
+                "samples": samples}
+
+    agg.ingest(wire(seq=1, families={"dl4j_test_depth": depth_fam(
+        [{"labels": {"q": "a"}, "value": 4.0},
+         {"labels": {"q": "b"}, "value": 9.0}])}))
+    assert fleet_value(agg, "dl4j_test_depth", "w1") == 13.0
+    agg.ingest(wire(seq=2, families={"dl4j_test_depth": depth_fam(
+        [{"labels": {"q": "a"}, "value": 5.0}])}))
+    assert fleet_value(agg, "dl4j_test_depth", "w1") == 5.0
+    text = agg.registry().to_prometheus()
+    assert 'q="b"' not in text
+    # an empty sample list clears the family outright
+    agg.ingest(wire(seq=3, families={"dl4j_test_depth": depth_fam([])}))
+    assert fleet_value(agg, "dl4j_test_depth", "w1") is None
 
 
 # ------------------------------------------- decode SLO attribution (e2e)
