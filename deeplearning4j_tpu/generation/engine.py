@@ -151,6 +151,13 @@ class GenerationEngine:
             self.prefix_cache.attach(self,
                                      progs.page_nbytes(self._pools))
         self.scheduler.reopen()   # a restart re-arms admission
+        from deeplearning4j_tpu.helpers import helpers_enabled
+        from deeplearning4j_tpu.helpers.paged_attention import (
+            paged_attention_mode)
+
+        self.metrics.fused_attention.set(
+            1.0 if helpers_enabled()
+            and paged_attention_mode() == "fused" else 0.0)
         self._stop_event.clear()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="generation-decode")

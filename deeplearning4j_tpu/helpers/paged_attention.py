@@ -1,0 +1,361 @@
+"""Fused paged decode attention (the ``gather_pages`` seam, fused).
+
+ROADMAP item 1's decode half: the continuous-batching engine's hot loop
+used to materialize every row's logical KV view from the page pool
+(``gather_pages`` -> ``paged_attention`` in ``nn/layers/attention.py``)
+— a ``[B, MAXP*page_size, Hkv, D]`` round trip through HBM per layer
+per decode step, just to immediately reduce it through a softmax.  This
+module computes the same per-row causal attention DIRECTLY from the
+flattened page pool + int32 block tables, streaming pages block-by-block
+with the online-softmax recurrence (running row-max ``m``, normaliser
+``l`` — the flash-attention scheme, see ``helpers/flash_attention.py``),
+so the gathered view is never built.
+
+Two implementations behind one public op:
+
+- ``impl="pallas"`` (default on TPU): a Pallas kernel on the grid
+  ``(B, Hkv, MAXP)`` whose sequential page axis carries the softmax
+  scratch.  The block table and per-row positions ride scalar prefetch
+  (``PrefetchScalarGridSpec``), so each page's HBM->VMEM DMA is issued
+  straight off ``block[b, p]`` — the kernel IS the gather.  Pages that
+  lie wholly above every live position of a row batch are skipped:
+  their compute is predicated off and their DMA index clamps to the
+  last live page (the Pallas pipeline elides copies whose index did
+  not change), so a 3-page row in a 32-page table pays for 3 pages.
+- ``impl="lax"`` (default elsewhere): a compiled ``lax.fori_loop`` over
+  pages with the same online-softmax accumulator, gathering only one
+  ``[B, page_size, Hkv, D]`` page slab per iteration.  The loop bound
+  is the live-page watermark ``max(q_positions)//page_size + 1`` — a
+  traced value (no recompiles; decode is inference-only so the dynamic
+  ``while_loop`` lowering needs no reverse pass), which is where the
+  measured CPU decode win comes from: the legacy gather always pays
+  all MAXP pages.
+
+Semantics match the legacy pair exactly (the flag-selectable oracle):
+GQA contracts the UNEXPANDED kv heads, masking is per-row
+``q_positions >= key_position`` where a key's global position is its
+logical slot index ``p*page_size + i`` — which also hides unwritten
+pages and trash-page-0 padding entries (their logical slots sit past
+the row's position).  See docs/serving.md "The fused decode kernel"
+for the seam contract, including the plan to dequantize int8/fp8 pages
+(ROADMAP item 3) inside this kernel.
+
+Mode toggle (trace-time, like ``enable_helpers``):
+``set_paged_attention_mode("gather")`` or env DL4J_TPU_PAGED_GATHER=1
+routes ``SelfAttentionLayer._apply_paged`` back through the legacy
+gather+softmax path — the bit-compatible oracle the parity tests and
+the bench's before/after arm compare against.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.helpers import interpret_mode as _interpret
+
+LANES = 128
+NEG_INF = -1e30
+
+# jax-version seams (same policy as helpers/flash_attention.py; the
+# kernel-trust harness classifies these as reference-setup divergences)
+_typeof = getattr(jax, "typeof", None)
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+_VALID_MODES = ("fused", "gather")
+_mode = ("gather" if os.environ.get("DL4J_TPU_PAGED_GATHER", "0") == "1"
+         else "fused")
+
+
+def set_paged_attention_mode(mode: str) -> None:
+    """Select the paged decode path: ``"fused"`` (default — this module)
+    or ``"gather"`` (the legacy gather+softmax oracle).  NOTE: routing
+    happens at TRACE time; already-compiled decode programs (a started
+    GenerationEngine's warmed program set) keep whichever path they were
+    traced with — toggle BEFORE building the engine."""
+    if mode not in _VALID_MODES:
+        raise ValueError(f"paged attention mode {mode!r} not in "
+                         f"{_VALID_MODES}")
+    global _mode
+    _mode = mode
+
+
+def paged_attention_mode() -> str:
+    return _mode
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s varying-mesh-axes set (see
+    flash_attention._sds; jax.typeof is post-0.4.x)."""
+    vma = getattr(_typeof(like), "vma", None) if _typeof is not None else None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _dot_f32(a, b, trans_b=False):
+    cb = 1 if trans_b else 0
+    return jax.lax.dot_general(
+        a, b, (((1,), (cb,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _check_shapes(q, pk, pv, block, q_positions, page_size):
+    b, t, hq, d = q.shape
+    if pk.ndim != 3 or pk.shape != pv.shape:
+        raise ValueError(
+            f"paged pools must be flattened [P*page_size, Hkv, D]; got "
+            f"pk {pk.shape}, pv {pv.shape}")
+    hkv = pk.shape[1]
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
+    if pk.shape[0] % page_size:
+        raise ValueError(
+            f"pool rows {pk.shape[0]} not a multiple of page_size "
+            f"{page_size}")
+    if block.shape[0] != b or block.ndim != 2:
+        raise ValueError(
+            f"block table {block.shape} does not match batch {b}")
+    if q_positions.shape != (b, t):
+        raise ValueError(
+            f"q_positions {q_positions.shape} must be [B, T] = {(b, t)}")
+    return hkv, d
+
+
+# ---------------------------------------------------------------------------
+# lax fallback: fori_loop over live pages, online softmax
+# ---------------------------------------------------------------------------
+
+def _lax_paged(q, pk, pv, block, q_positions, page_size):
+    """Compiled page-streaming fallback for non-TPU backends.  One
+    ``[B, page_size, Hkv, D]`` slab in flight at a time; loop bound is
+    the dynamic live-page watermark (traced -> while_loop -> zero
+    steady-state recompiles)."""
+    b, t, hq, d = q.shape
+    hkv = pk.shape[1]
+    g = hq // hkv
+    maxp = block.shape[1]
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+    offs = jnp.arange(page_size, dtype=block.dtype)
+    # [B, T, Hkv, G, D] — contract the UNEXPANDED kv heads (GQA)
+    qg = q.reshape(b, t, hkv, g, d).astype(acc_dt)
+    m0 = jnp.full((b, hkv, g, t), NEG_INF, acc_dt)
+    l0 = jnp.zeros((b, hkv, g, t), acc_dt)
+    a0 = jnp.zeros((b, t, hkv, g, d), acc_dt)
+
+    def body(p, carry):
+        m, l, acc = carry
+        slots = block[:, p][:, None] * page_size + offs[None]  # [B, ps]
+        k = pk[slots].astype(acc_dt)                  # [B, ps, Hkv, D]
+        v = pv[slots].astype(acc_dt)
+        kpos = p * page_size + offs
+        s = jnp.einsum("bthgd,bkhd->bhgtk", qg, k) * scale
+        keep = (q_positions[:, None, None, :, None]
+                >= kpos[None, None, None, None, :])
+        s = jnp.where(keep, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p_exp = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p_exp, axis=-1)
+        acc_new = (acc * alpha.transpose(0, 3, 1, 2)[..., None]
+                   + jnp.einsum("bhgtk,bkhd->bthgd", p_exp, v))
+        return m_new, l_new, acc_new
+
+    live = jnp.minimum(jnp.max(q_positions) // page_size + 1, maxp)
+    m, l, acc = jax.lax.fori_loop(0, live, body, (m0, l0, a0))
+    safe = jnp.where(l > 0, l, 1.0)                   # NaN-safe idle rows
+    o = acc / safe.transpose(0, 3, 1, 2)[..., None]
+    return o.reshape(b, t, hq, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: grid (B, Hkv, MAXP), scalar-prefetched block table
+# ---------------------------------------------------------------------------
+
+def _row_max_qpos(qp_ref, b, t):
+    m = qp_ref[b, 0]
+    for i in range(1, t):
+        m = jnp.maximum(m, qp_ref[b, i])
+    return m
+
+
+def _decode_kernel(blk_ref, qp_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size, t):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    npages = pl.num_programs(2)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages wholly above every row position contribute nothing; their
+    # DMA already clamped to the last live page (see _kv_index)
+    run = p * page_size <= _row_max_qpos(qp_ref, b, t)
+
+    @pl.when(run)
+    def _step():
+        s = _dot_f32(q_ref[:], k_ref[:], trans_b=True) * scale  # [GT, ps]
+        kpos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # q rows are laid out [G, T] flattened (t = row % T); per-row
+        # global positions come off the prefetched scalars
+        qpm = jnp.full(s.shape, qp_ref[b, 0], jnp.int32)
+        if t > 1:
+            rt = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % t
+            for i in range(1, t):
+                qpm = jnp.where(rt == i, qp_ref[b, i], qpm)
+        s = jnp.where(qpm >= kpos, s, NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p_exp = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p_exp, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + _dot_f32(
+            p_exp.astype(v_ref.dtype), v_ref[:])
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(p == npages - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        safe = jnp.where(l > 0, l, 1.0)               # idle / trash rows
+        o_ref[:] = (acc_scr[:] / safe).astype(o_ref.dtype)
+
+
+def _kv_index(page_size, t):
+    """K/V page index straight off the scalar-prefetched block table;
+    dead pages clamp to the last live one so their copies are elided."""
+    def idx(b, h, p, blk_ref, qp_ref):
+        hi = _row_max_qpos(qp_ref, b, t) // page_size
+        return (blk_ref[b, jnp.minimum(p, hi)], h, 0)
+    return idx
+
+
+def _pallas_paged(q, pk, pv, block, q_positions, page_size, interpret):
+    b, t, hq, d = q.shape
+    hkv = pk.shape[1]
+    g = hq // hkv
+    gt = g * t
+    maxp = block.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    dp = (-d) % LANES
+    if dp:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dp)))
+        pk = jnp.pad(pk, ((0, 0), (0, 0), (0, dp)))
+        pv = jnp.pad(pv, ((0, 0), (0, 0), (0, dp)))
+    dpad = d + dp
+    # [B, Hkv, G*T, D]: one grid step owns one (batch row, kv head)
+    qb = (q.reshape(b, t, hkv, g, dpad).transpose(0, 2, 3, 1, 4)
+          .reshape(b, hkv, gt, dpad))
+    block = block.astype(jnp.int32)
+    qpos = q_positions.astype(jnp.int32)
+    kern = functools.partial(_decode_kernel, scale=scale,
+                             page_size=page_size, t=t)
+    kv_idx = _kv_index(page_size, t)
+    o = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, hkv, maxp),
+            in_specs=[
+                pl.BlockSpec((None, None, gt, dpad),
+                             lambda bi, h, p, blk, qp: (bi, h, 0, 0)),
+                pl.BlockSpec((page_size, None, dpad), kv_idx),
+                pl.BlockSpec((page_size, None, dpad), kv_idx),
+            ],
+            out_specs=pl.BlockSpec(
+                (None, None, gt, dpad),
+                lambda bi, h, p, blk, qp: (bi, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((gt, LANES), jnp.float32),
+                pltpu.VMEM((gt, LANES), jnp.float32),
+                pltpu.VMEM((gt, dpad), jnp.float32),
+            ],
+        ),
+        out_shape=_sds((b, hkv, gt, dpad), q.dtype, q),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(block, qpos, qb, pk, pv)
+    o = (o.reshape(b, hkv, g, t, dpad).transpose(0, 3, 1, 2, 4)
+         .reshape(b, t, hq, dpad))
+    return o[..., :d] if dp else o
+
+
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+def paged_decode_attention(q: jax.Array, pk: jax.Array, pv: jax.Array,
+                           block: jax.Array, q_positions: jax.Array, *,
+                           page_size: int,
+                           impl: Optional[str] = None,
+                           interpret: Optional[bool] = None) -> jax.Array:
+    """Per-row causal attention of ``q`` [B, T, Hq, D] directly over the
+    flattened page pool ``pk``/``pv`` [P*page_size, Hkv, D] through the
+    int32 block table ``block`` [B, MAXP] — never materializing the
+    gathered [B, MAXP*page_size, Hkv, D] view.
+
+    A key's global position is its logical slot index
+    ``p * page_size + i``; masking is ``q_positions >= key position``
+    per row, which (exactly as the legacy ``paged_attention`` documents)
+    also hides unwritten pages and trash-page-0 padding entries.  GQA
+    contracts the unexpanded kv heads.
+
+    ``impl``: None picks ``"pallas"`` on TPU and ``"lax"`` elsewhere;
+    ``"gather"`` routes through the legacy gather+softmax pair (the
+    bit-compatible oracle).  ``interpret`` only applies to the Pallas
+    path (defaults to the package policy: interpret off-TPU).
+    """
+    hkv, d = _check_shapes(q, pk, pv, block, q_positions, page_size)
+    if impl is None:
+        impl = "pallas" if jax.default_backend() == "tpu" else "lax"
+    if impl == "gather":
+        from deeplearning4j_tpu.nn.layers.attention import (
+            gather_pages, paged_attention)
+
+        gk = gather_pages(pk, block, page_size).astype(q.dtype)
+        gv = gather_pages(pv, block, page_size).astype(q.dtype)
+        return paged_attention(q, gk, gv, q_positions)
+    if impl == "lax":
+        return _lax_paged(q, pk, pv, block, q_positions, page_size)
+    if impl != "pallas":
+        raise ValueError(f"impl={impl!r} not one of pallas/lax/gather")
+    if interpret is None:
+        interpret = _interpret()
+    return _pallas_paged(q, pk, pv, block, q_positions, page_size,
+                         interpret)
+
+
+class PagedAttentionHelper:
+    """Discovery-seam wrapper for the paged decode path (≙ the cuDNN
+    helper SPI, like FlashAttentionHelper): ``SelfAttentionLayer.
+    _apply_paged`` asks ``helpers.get_helper("paged_attention")`` and
+    falls back to the legacy gather+softmax pair when this returns
+    unsupported.  Unlike the flash helper, the fused path is the
+    DEFAULT on every backend — off TPU it routes to the compiled lax
+    page-streaming fallback, not the Pallas interpreter, so CPU decode
+    gets the live-page watermark win too."""
+
+    name = "PagedAttentionHelper"
+
+    def supports(self, q, page_size: int) -> bool:
+        return paged_attention_mode() == "fused"
+
+    def attend(self, q, pk, pv, block, q_positions, *,
+               page_size: int) -> jax.Array:
+        return paged_decode_attention(q, pk, pv, block, q_positions,
+                                      page_size=page_size)

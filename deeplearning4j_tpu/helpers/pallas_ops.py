@@ -335,3 +335,11 @@ def register_default_helpers() -> None:
         from deeplearning4j_tpu.helpers.flash_attention import FlashAttentionHelper
 
         _helpers.register_helper("attention", FlashAttentionHelper())
+    if "paged_attention" not in _helpers._registry:
+        from deeplearning4j_tpu.helpers.paged_attention import PagedAttentionHelper
+
+        _helpers.register_helper("paged_attention", PagedAttentionHelper())
+    if "epilogue" not in _helpers._registry:
+        from deeplearning4j_tpu.helpers.fused_epilogue import FusedEpilogueHelper
+
+        _helpers.register_helper("epilogue", FusedEpilogueHelper())

@@ -151,9 +151,13 @@ def gather_pages(pages: jax.Array, block: jax.Array,
     ``pages`` [P * page_size, Hkv, D] (the flattened pool), ``block``
     [B, MAXP] int32 per-row page ids: returns [B, MAXP * page_size, Hkv,
     D] where flat position ``i`` of row ``b`` is global stream position
-    ``i`` of that row's sequence.  This is the paged-gather seam — a
-    fused decode-attention helper (roadmap item 5) replaces exactly this
-    gather + the softmax that follows."""
+    ``i`` of that row's sequence.  This is the paged-gather seam — the
+    fused decode-attention helper (roadmap item 1,
+    ``helpers/paged_attention.py``) replaces exactly this gather + the
+    softmax that follows, and is the DEFAULT decode path; this function
+    + ``paged_attention`` remain the flag-selectable bit-compatible
+    oracle (``DL4J_TPU_PAGED_GATHER=1`` or
+    ``set_paged_attention_mode("gather")``)."""
     b, maxp = block.shape
     slots = block[:, :, None] * page_size + jnp.arange(page_size)[None, None]
     return pages[slots.reshape(b, maxp * page_size)]
@@ -355,9 +359,19 @@ class SelfAttentionLayer(Layer):
         pvf = carry["pv"].reshape(-1, hkv, dh)
         pkf = pkf.at[flat].set(k.reshape(-1, hkv, dh).astype(pkf.dtype))
         pvf = pvf.at[flat].set(v.reshape(-1, hkv, dh).astype(pvf.dtype))
-        gk = gather_pages(pkf, block, ps).astype(q.dtype)
-        gv = gather_pages(pvf, block, ps).astype(q.dtype)
-        o = paged_attention(q, gk, gv, new_pos)
+        from deeplearning4j_tpu.helpers import get_helper
+
+        helper = get_helper("paged_attention")
+        if helper is not None and helper.supports(q, ps):
+            # fused paged decode attention (roadmap item 1): attends
+            # straight off the pool + block table, never materializing
+            # the gathered [B, MAXP*page_size, Hkv, D] view
+            o = helper.attend(q, pkf, pvf, block, new_pos, page_size=ps)
+        else:
+            # legacy gather+softmax oracle (DL4J_TPU_PAGED_GATHER=1)
+            gk = gather_pages(pkf, block, ps).astype(q.dtype)
+            gv = gather_pages(pvf, block, ps).astype(q.dtype)
+            o = paged_attention(q, gk, gv, new_pos)
         new_carry = {"pk": pkf.reshape(carry["pk"].shape),
                      "pv": pvf.reshape(carry["pv"].shape),
                      "block": block, "pos": pos + t_new}

@@ -63,15 +63,58 @@ class ResidualBlock(Layer):
                     f"(got state from {type(sub).__name__})")
         return {}
 
+    def _fused_prologue_helper(self, x):
+        """The train-side fusion seam (roadmap item 1): a pre-norm block
+        opens LayerNorm -> sublayer, i.e. the sublayer consumes
+        ``dropout(LayerNorm(x))`` — exactly the fused
+        dropout+residual+norm kernel's prologue form
+        (``helpers/fused_epilogue.py``).  Returns the helper when the
+        block shape and input qualify, else None (stock jnp path —
+        which IS the parity reference)."""
+        if len(self.layers) < 2:
+            return None
+        from deeplearning4j_tpu.nn.layers.normalization import LayerNorm
+
+        ln = self.layers[0]
+        if not isinstance(ln, LayerNorm) or ln.activation != "identity":
+            return None
+        from deeplearning4j_tpu.helpers import get_helper
+
+        helper = get_helper("epilogue")
+        if helper is None or not helper.supports(x):
+            return None
+        return helper
+
     def apply(self, params, state, x, *, train=False, rng=None, mask=None):
         import inspect
 
         rngs = (jax.random.split(rng, len(self.layers))
                 if rng is not None else [None] * len(self.layers))
+        fused = self._fused_prologue_helper(x)
 
         def body(params, x, rngs, mask):
             h = x
-            for i, sub in enumerate(self.layers):
+            start = 0
+            if fused is not None:
+                ln, sub1 = self.layers[0], self.layers[1]
+                # fold sub1's INPUT dropout (reference applyDropout
+                # semantics — see Layer.maybe_dropout) into the fused
+                # norm; the mask key is sub1's own rng, so the drawn
+                # mask is bit-identical to the unfused path's
+                rate = (sub1.dropout if train and sub1.dropout > 0.0
+                        and not sub1.drop_connect else 0.0)
+                h = fused.prologue(
+                    h, params["sub0"]["gamma"], params["sub0"]["beta"],
+                    eps=ln.eps, rate=rate, rng=rngs[1], train=train)
+                sub1r = (dataclasses.replace(sub1, dropout=0.0)
+                         if rate > 0.0 else sub1)
+                kw = ({"mask": mask} if mask is not None and "mask" in
+                      inspect.signature(sub1r.apply).parameters else {})
+                h, _ = sub1r.apply(params.get("sub1", {}), {}, h,
+                                   train=train, rng=rngs[1], **kw)
+                start = 2
+            for i in range(start, len(self.layers)):
+                sub = self.layers[i]
                 kw = ({"mask": mask} if mask is not None
                       and "mask" in inspect.signature(sub.apply).parameters else {})
                 h, _ = sub.apply(params.get(f"sub{i}", {}), {}, h,

@@ -153,6 +153,21 @@ def _np_gather_pages(pages, block, page_size: int) -> np.ndarray:
     b, maxp = block.shape
     return out.reshape((b, maxp * page_size) + pages.shape[1:])
 
+def _np_dropout_residual_norm(h, res, gamma, beta, eps, mask,
+                              keep) -> np.ndarray:
+    """float64 dropout(LayerNorm_affine(res + h)) — the fused train-step
+    epilogue's reference (``helpers/fused_epilogue.py``)."""
+    x = np.asarray(h, np.float64)
+    if res is not None:
+        x = x + np.asarray(res, np.float64)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    y = ((x - mu) / np.sqrt(var + eps) * np.asarray(gamma, np.float64)
+         + np.asarray(beta, np.float64))
+    if mask is not None:
+        y = np.where(np.asarray(mask), y / keep, 0.0)
+    return y
+
 def _np_lrn(x2d, k, n, alpha, beta) -> np.ndarray:
     x = np.asarray(x2d, np.float64)
     half = n // 2
@@ -310,6 +325,92 @@ def _run_paged_attention(cfg) -> Tuple[Any, np.ndarray]:
     ref = _np_attention(q, k, v, causal=True, q_positions=qpos)
     return out, ref
 
+def _fused_paged_configs(full: bool):
+    # engine-shaped grids: page 0 is the TRASH page (unassigned block-table
+    # slots point at it), per-row positions are mixed, and row 0 is the
+    # all-padding row (fresh slot: block all-trash, position 0)
+    grids = [{"pages": 10, "page_size": 8, "maxp": 4, "hq": 4, "hkv": 2,
+              "d": 32, "b": 3, "t": 1}]
+    if full:
+        grids += [
+            # non-GQA, multi-token chunk (speculative/chunked decode shape)
+            {"pages": 12, "page_size": 8, "maxp": 4, "hq": 4, "hkv": 4,
+             "d": 64, "b": 2, "t": 2},
+            # non-lane-multiple head dim exercises the Pallas lane padding
+            {"pages": 8, "page_size": 16, "maxp": 3, "hq": 8, "hkv": 2,
+             "d": 48, "b": 4, "t": 1},
+        ]
+    for g in grids:
+        for dtype in ("float32", "bfloat16"):
+            yield dict(g, dtype=dtype)
+
+def _run_fused_paged(cfg) -> Tuple[Any, np.ndarray]:
+    """Both fused impls (lax fallback AND the Pallas kernel interpreted)
+    against one f64 gather+softmax reference, concatenated into a single
+    flat comparison — the bn_training precedent: one registry entry
+    certifies every implementation behind the seam."""
+    from deeplearning4j_tpu.helpers.paged_attention import (
+        paged_decode_attention)
+    dt = jnp.dtype(cfg["dtype"])
+    ps, maxp, t = cfg["page_size"], cfg["maxp"], cfg["t"]
+    pool_k = _rng(cfg["pages"] * ps, cfg["hkv"], cfg["d"], dtype=dt, seed=20)
+    pool_v = _rng(cfg["pages"] * ps, cfg["hkv"], cfg["d"], dtype=dt, seed=21)
+    q = _rng(cfg["b"], t, cfg["hq"], cfg["d"], dtype=dt, seed=22)
+    rng = np.random.default_rng(23)
+    block = rng.integers(1, cfg["pages"], size=(cfg["b"], maxp))
+    qlast = rng.integers(t - 1, maxp * ps, size=(cfg["b"],))
+    qlast[0] = t - 1
+    block[0] = 0                                 # all-padding trash row
+    for bi in range(cfg["b"]):
+        live = int(qlast[bi]) // ps + 1
+        block[bi, live:] = 0                     # trash-page-0 padding
+    qpos = (qlast - (t - 1))[:, None] + np.arange(t)[None]
+    blockj = jnp.asarray(block, jnp.int32)
+    qposj = jnp.asarray(qpos, jnp.int32)
+    out_lax = paged_decode_attention(q, pool_k, pool_v, blockj, qposj,
+                                     page_size=ps, impl="lax")
+    out_pl = paged_decode_attention(q, pool_k, pool_v, blockj, qposj,
+                                    page_size=ps, impl="pallas",
+                                    interpret=True)
+    gk = _np_gather_pages(pool_k, block, ps)
+    gv = _np_gather_pages(pool_v, block, ps)
+    ref = _np_attention(q, gk, gv, causal=True, q_positions=qpos)
+    out = jnp.concatenate([out_lax.reshape(-1), out_pl.reshape(-1)])
+    return out, np.concatenate([ref.reshape(-1), ref.reshape(-1)])
+
+def _epilogue_configs(full: bool):
+    shapes = [(24, 96)]
+    if full:
+        shapes += [(64, 128), (17, 40)]          # incl. pad-heavy odd shape
+    for m, c in shapes:
+        for dtype in ("float32", "bfloat16"):
+            for variant in ("residual_dropout", "prologue", "norm_only"):
+                yield {"shape": [m, c], "dtype": dtype, "variant": variant}
+
+def _run_epilogue(cfg) -> Tuple[Any, np.ndarray]:
+    from deeplearning4j_tpu.helpers.fused_epilogue import (
+        dropout_residual_norm)
+    m, c = cfg["shape"]
+    dt = jnp.dtype(cfg["dtype"])
+    h = _rng(m, c, dtype=dt, seed=30)
+    gamma = _rng(c, dtype=jnp.float32, seed=32)
+    beta = _rng(c, dtype=jnp.float32, seed=33)
+    variant = cfg["variant"]
+    res = (_rng(m, c, dtype=dt, seed=31)
+           if variant == "residual_dropout" else None)
+    mask, keep, rate = None, 1.0, 0.0
+    if variant != "norm_only":
+        keep, rate = 0.75, 0.25
+        # explicit mask so the f64 reference sees the exact keep pattern
+        mask = jnp.asarray(
+            np.random.default_rng(34).random((m, c)) < keep)
+    out = dropout_residual_norm(h, res, gamma, beta, eps=1e-5, rate=rate,
+                                mask=mask)
+    ref = _np_dropout_residual_norm(
+        h, res, gamma, beta, 1e-5,
+        np.asarray(mask) if mask is not None else None, keep)
+    return out, ref
+
 def _pallas2d_configs(full: bool):
     shapes = [(32, 24)]
     if full:
@@ -354,6 +455,8 @@ KERNELS: Dict[str, Tuple[Callable, Callable, bool]] = {
     "dot_product_attention": (_dpa_configs, _run_dpa, False),
     "gather_pages": (_paged_configs, _run_gather, True),
     "paged_attention": (_paged_configs, _run_paged_attention, False),
+    "fused_paged_attention": (_fused_paged_configs, _run_fused_paged, False),
+    "fused_dropout_residual_norm": (_epilogue_configs, _run_epilogue, False),
     "pallas_lrn": (_pallas2d_configs, _run_lrn, False),
     "pallas_bn_inference": (_pallas2d_configs, _run_bn_inference, False),
     "pallas_bn_training": (_pallas2d_configs, _run_bn_training, False),
@@ -425,7 +528,8 @@ def run_sweep(kernels: Optional[Sequence[str]] = None,
             "trusted": cls in ("within_tolerance", "tolerance_only"),
             "max_rel_error": worst["max_rel_error"] if worst else None,
             "worst_config": ({k: worst[k] for k in
-                              ("shape", "dtype", "causal", "window")
+                              ("shape", "dtype", "causal", "window",
+                               "variant", "page_size", "pages")
                               if k in worst} if worst else None),
         }
         report["kernels"][name] = kd
@@ -475,6 +579,33 @@ def format_report(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def check_registry(trust_path: str) -> int:
+    """CI gate: every kernel in the committed trust document must exist
+    in this registry and vice versa — a fused kernel that is not swept
+    has no claim to trust, and a trust entry with no surviving kernel is
+    stale evidence.  Returns a nonzero exit code on any mismatch."""
+    with open(trust_path) as f:
+        doc = json.load(f)
+    in_doc = set(doc.get("kernels", {}))
+    in_reg = set(KERNELS)
+    rc = 0
+    for name in sorted(in_reg - in_doc):
+        print(f"kernel '{name}' is registered in kerneldiff but absent "
+              f"from {trust_path} — regenerate the trust document "
+              "(python -m deeplearning4j_tpu.observability.kerneldiff "
+              f"--full --out {trust_path})", file=sys.stderr)
+        rc = 1
+    for name in sorted(in_doc - in_reg):
+        print(f"kernel '{name}' appears in {trust_path} but has no "
+              "kerneldiff registry entry — its trust evidence is stale",
+              file=sys.stderr)
+        rc = 1
+    if rc == 0:
+        print(f"registry <-> {trust_path} consistent "
+              f"({len(in_reg)} kernels)")
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--out", default=None, help="write kernel_trust.json")
@@ -485,7 +616,12 @@ def main(argv=None) -> int:
     ap.add_argument("--baseline", default=None,
                     help="compare against a committed kernel_trust.json "
                          "with regression.KERNEL_TRUST_RULES")
+    ap.add_argument("--check-registry", default=None, metavar="PATH",
+                    help="no sweep: verify the committed trust document "
+                         "and this registry list the same kernels")
     args = ap.parse_args(argv)
+    if args.check_registry:
+        return check_registry(args.check_registry)
     names = args.kernels.split(",") if args.kernels else None
     report = run_sweep(kernels=names, full=args.full)
     publish_metrics(report)

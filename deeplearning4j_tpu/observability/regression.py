@@ -127,6 +127,24 @@ DEFAULT_RULES: List[Rule] = [
          tolerance=0.0, required=False),
     Rule("Generation tokens/sec", field="slo.publisher_host_sync_free",
          tolerance=0.0, required=False),
+    # fused paged decode (ISSUE 19): speedup_vs_gather pins the measured
+    # fused-vs-gather-oracle throughput ratio on this container;
+    # fused_no_slower (1 = the fused default is at least as fast) and
+    # gather_share_collapsed (1 = the per-token decode-step cost the
+    # gather used to pay has collapsed) are exact sentinels — a change
+    # that silently routes decode back through the materialized gather
+    # drops them to 0 and fails immediately; the exact-zero compile rule
+    # pins the fused program set's AOT-warmup contract
+    Rule("Generation tokens/sec", field="fused_decode.speedup_vs_gather",
+         tolerance=0.4, required=False),
+    Rule("Generation tokens/sec", field="fused_decode.fused_no_slower",
+         tolerance=0.0, required=False),
+    Rule("Generation tokens/sec",
+         field="fused_decode.gather_share_collapsed",
+         tolerance=0.0, required=False),
+    Rule("Generation tokens/sec",
+         field="fused_decode.steady_state_compiles",
+         direction=LOWER, tolerance=0.0, required=False),
     Rule("Long-context train tokens/sec", tolerance=0.4),
     Rule("Serving rows/sec", tolerance=0.4),
     Rule("Serving rows/sec", field="p99_ms", direction=LOWER, tolerance=1.0,
@@ -251,6 +269,14 @@ KERNEL_TRUST_RULES: List[Rule] = [
          tolerance=0.0),
     Rule("Kernel max rel error (paged_attention)", direction=LOWER,
          tolerance=1.0),
+    # the fused decode kernel (ISSUE 19) sweeps BOTH impls behind the
+    # seam (lax fallback + interpreted Pallas) in one flat comparison;
+    # the train-step epilogue likewise covers residual/prologue/norm-only
+    # variants under one entry
+    Rule("Kernel max rel error (fused_paged_attention)", direction=LOWER,
+         tolerance=1.0),
+    Rule("Kernel max rel error (fused_dropout_residual_norm)",
+         direction=LOWER, tolerance=1.0),
     Rule("Kernel max rel error (pallas_lrn)", direction=LOWER,
          tolerance=1.0, required=False),
     Rule("Kernel max rel error (pallas_bn_inference)", direction=LOWER,

@@ -183,6 +183,13 @@ class GenerationMetrics:
             "dl4j_decode_page_utilization",
             "Allocated fraction of the paged KV pool (trash page "
             "excluded)", labels=("engine",)).labels(engine=self.engine_id)
+        self.fused_attention = reg.gauge(
+            "dl4j_decode_fused_attention",
+            "1 when decode attention runs the fused paged kernel "
+            "(helpers/paged_attention.py, pool + block table streamed "
+            "through an online-softmax accumulator), 0 on the legacy "
+            "gather+softmax oracle (DL4J_TPU_PAGED_GATHER=1 or helpers "
+            "disabled)", labels=("engine",)).labels(engine=self.engine_id)
         self.prefix_cache_resident = reg.gauge(
             "dl4j_prefix_cache_resident_pages",
             "Device pages the prefix-cache radix tree currently keeps "

@@ -13,9 +13,14 @@ Runs, in order:
    ``bench_full.json``;
 4. ``fleet schema self-test`` — the fleet telemetry snapshot's
    serialize → merge → re-export round trip must be bit-stable
-   (``observability.fleet.schema_roundtrip_selftest``).
+   (``observability.fleet.schema_roundtrip_selftest``);
+5. ``kernel-trust registry`` — the committed ``kernel_trust.json`` and
+   the kerneldiff sweep registry must list the same kernels in both
+   directions, so no fused kernel can merge without sweep evidence and
+   no stale trust entry can outlive its kernel
+   (``kerneldiff --check-registry``).
 
-All four run in a few seconds with no device work — this is the
+All five run in a few seconds with no device work — this is the
 pre-test gate: run it before the pytest tiers and fail fast on lint
 debt, a broken sentinel, or a fleet wire-schema drift.
 
@@ -54,6 +59,10 @@ CHECKS: List[Tuple[str, List[str]]] = [
       "from deeplearning4j_tpu.observability.fleet import "
       "schema_roundtrip_selftest; "
       "sys.exit(schema_roundtrip_selftest(verbose=True))"]),
+    ("kernel-trust registry",
+     [sys.executable, "-m",
+      "deeplearning4j_tpu.observability.kerneldiff",
+      "--check-registry", os.path.join(REPO, "kernel_trust.json")]),
 ]
 
 

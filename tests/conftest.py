@@ -114,6 +114,13 @@ def pytest_configure(config):
         "staleness), decode SLO attribution (TTFT/ITL/goodput, phase "
         "breakdown), and the router-facing cache stats surface "
         "(python -m pytest -m fleet)")
+    config.addinivalue_line(
+        "markers",
+        "kernels: fused-kernel tests — the Pallas paged decode-attention "
+        "kernel (lax + interpret impls vs the gather oracle, engine-level "
+        "parity) and the fused dropout/residual/norm train epilogue "
+        "(parity, grads, dropout-mask bit-identity) "
+        "(python -m pytest -m kernels)")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,450 @@
+"""Fused-kernel tests (ISSUE 19): the paged decode-attention kernel
+(`helpers/paged_attention.py`) and the fused dropout/residual/norm train
+epilogue (`helpers/fused_epilogue.py`).
+
+The decode kernel's contract: computing per-row causal attention straight
+off the flattened page pool + int32 block tables must match the legacy
+gather+softmax oracle (``gather_pages`` + ``paged_attention``) on every
+impl (lax fallback, interpreted Pallas) and at every integration level —
+raw function, layer-level streaming across a page boundary, and the full
+continuous-batching engine under join/leave, prefix-cache-hit, and
+hot-swap traffic.  The epilogue's contract: one fused VMEM pass equals
+LayerNorm + inverted dropout in jnp, forward and backward, with a
+bit-identical bernoulli mask for the same rng key.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deeplearning4j_tpu.helpers as helpers
+from deeplearning4j_tpu.helpers.fused_epilogue import (
+    FusedEpilogueHelper, dropout_residual_norm,
+)
+from deeplearning4j_tpu.helpers.paged_attention import (
+    PagedAttentionHelper, paged_attention_mode, paged_decode_attention,
+    set_paged_attention_mode,
+)
+from deeplearning4j_tpu.nn.layers.attention import (
+    SelfAttentionLayer, gather_pages, paged_attention,
+)
+
+pytestmark = pytest.mark.kernels
+
+VOCAB = 29
+
+
+# --------------------------------------------------------------- scenarios
+def _scenario(seed, *, pages, page_size, maxp, b, t, hq, hkv, d,
+              dtype=jnp.float32, trash_row=True):
+    """Engine-shaped inputs: page 0 is the trash page, unassigned
+    block-table slots point at it, per-row positions are mixed, and
+    (``trash_row``) row 0 is an all-padding fresh slot at position 0."""
+    rng = np.random.default_rng(seed)
+    pool_k = jnp.asarray(
+        rng.standard_normal((pages * page_size, hkv, d)), dtype)
+    pool_v = jnp.asarray(
+        rng.standard_normal((pages * page_size, hkv, d)), dtype)
+    q = jnp.asarray(rng.standard_normal((b, t, hq, d)), dtype)
+    block = rng.integers(1, pages, size=(b, maxp))
+    qlast = rng.integers(t - 1, maxp * page_size, size=(b,))
+    if trash_row:
+        qlast[0] = t - 1
+        block[0] = 0
+    for bi in range(b):
+        live = int(qlast[bi]) // page_size + 1
+        block[bi, live:] = 0
+    qpos = (qlast - (t - 1))[:, None] + np.arange(t)[None]
+    return (q, pool_k, pool_v, jnp.asarray(block, jnp.int32),
+            jnp.asarray(qpos, jnp.int32))
+
+
+def _oracle(q, pk, pv, block, qpos, page_size):
+    gk = gather_pages(pk, block, page_size).astype(q.dtype)
+    gv = gather_pages(pv, block, page_size).astype(q.dtype)
+    return paged_attention(q, gk, gv, qpos)
+
+
+CONFIGS = {
+    "gqa": dict(pages=10, page_size=8, maxp=4, b=3, t=1, hq=4, hkv=2, d=32),
+    "mha_chunk": dict(pages=12, page_size=8, maxp=4, b=2, t=2, hq=4,
+                      hkv=4, d=64),
+    "odd_head_dim": dict(pages=8, page_size=16, maxp=3, b=4, t=1, hq=8,
+                         hkv=2, d=48),
+}
+
+
+# ----------------------------------------------------- raw kernel parity
+@pytest.mark.parametrize("impl", ["lax", "pallas"])
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_fused_matches_gather_oracle(impl, name):
+    cfg = CONFIGS[name]
+    q, pk, pv, block, qpos = _scenario(7, **cfg)
+    ref = _oracle(q, pk, pv, block, qpos, cfg["page_size"])
+    out = paged_decode_attention(q, pk, pv, block, qpos,
+                                 page_size=cfg["page_size"], impl=impl,
+                                 interpret=True)
+    assert bool(jnp.isfinite(out).all())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("impl", ["lax", "pallas"])
+def test_all_padding_trash_row(impl):
+    """A fresh slot (block table all trash-page-0, position 0) must stay
+    finite and agree with the oracle — the engine pads every idle lane
+    this way, so a NaN here poisons the whole running batch."""
+    cfg = CONFIGS["gqa"]
+    q, pk, pv, block, qpos = _scenario(11, **cfg, trash_row=True)
+    assert int(block[0].max()) == 0 and int(qpos[0, 0]) == 0
+    out = paged_decode_attention(q, pk, pv, block, qpos,
+                                 page_size=cfg["page_size"], impl=impl,
+                                 interpret=True)
+    ref = _oracle(q, pk, pv, block, qpos, cfg["page_size"])
+    assert bool(jnp.isfinite(out).all())
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_mode_toggle_and_helper_gating():
+    assert paged_attention_mode() == "fused"       # the default
+    helper = PagedAttentionHelper()
+    q = jnp.zeros((1, 1, 4, 32))
+    assert helper.supports(q, 4)
+    try:
+        set_paged_attention_mode("gather")
+        assert paged_attention_mode() == "gather"
+        assert not helper.supports(q, 4)
+    finally:
+        set_paged_attention_mode("fused")
+    with pytest.raises(ValueError):
+        set_paged_attention_mode("einsum")
+
+
+def test_lax_fallback_zero_recompiles_across_fill_levels():
+    """The fori_loop fallback bounds its page walk by a TRACED watermark
+    (max position), so rows filling up over decode steps must not force
+    retraces — the engine's zero-steady-state-compile contract depends
+    on it."""
+    cfg = CONFIGS["gqa"]
+    ps = cfg["page_size"]
+    fn = jax.jit(lambda *a: paged_decode_attention(
+        *a, page_size=ps, impl="lax"))
+    q, pk, pv, block, qpos = _scenario(13, **cfg)
+    fn(q, pk, pv, block, qpos).block_until_ready()
+    traces = 0
+    for fill in (0, ps - 1, 2 * ps, 3 * ps + 1):
+        qp = jnp.full_like(qpos, fill)
+        with jax.log_compiles(False):
+            before = fn._cache_size()
+            fn(q, pk, pv, block, qp).block_until_ready()
+            traces += fn._cache_size() - before
+    assert traces == 0
+
+
+# ------------------------------------------------- layer-level streaming
+def test_row_crosses_page_boundary_mid_decode():
+    """Token-by-token streaming through ``apply_with_carry``: the row's
+    position walks across page boundaries (ps-1 -> ps allocates the next
+    page's lane); every step's fused output must match the gather
+    oracle's, including the boundary steps."""
+    ps, maxp, num_pages = 4, 3, 7
+    layer = SelfAttentionLayer(n_in=32, n_out=32, n_heads=4, causal=True,
+                               n_kv_heads=2)
+    params = layer.init(jax.random.PRNGKey(0))
+    steps = 2 * ps + 2                             # crosses two boundaries
+    xs = jax.random.normal(jax.random.PRNGKey(1), (steps, 1, 1, 32))
+    block = jnp.asarray([[1, 4, 2]], jnp.int32)    # page ids, row 0
+
+    def run():
+        carry = dict(layer.init_paged_cache(num_pages, ps),
+                     block=block, pos=jnp.zeros((1,), jnp.int32))
+        outs = []
+        for i in range(steps):
+            y, _, nc = layer.apply_with_carry(params, {}, xs[i], carry)
+            outs.append(y)
+            carry = dict(nc, block=block)
+        return outs
+
+    fused = run()
+    set_paged_attention_mode("gather")
+    try:
+        oracle = run()
+    finally:
+        set_paged_attention_mode("fused")
+    for i, (a, b) in enumerate(zip(fused, oracle)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6,
+            err_msg=f"step {i} (position {i}, page {i // ps})")
+
+
+# ------------------------------------------------- engine-level oracles
+def _small_lm(seed=12345):
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+
+    return transformer_char_lm(vocab_size=VOCAB, d_model=32, n_heads=4,
+                               layers=2, max_cache=128, seed=seed)
+
+
+def _engine(lm, **kw):
+    from deeplearning4j_tpu.generation import GenerationEngine
+
+    return GenerationEngine(lm, slots=4, page_size=4, max_context=32,
+                            max_queue=64, deadline_s=60.0, **kw).start()
+
+
+def _in_mode(mode, fn):
+    set_paged_attention_mode(mode)
+    try:
+        return fn()
+    finally:
+        set_paged_attention_mode("fused")
+
+
+def test_engine_join_leave_parity_fused_vs_gather(rng):
+    """The PR-13 scheduler oracle, run cross-mode: mixed join/leave
+    traffic on the fused default must produce the same greedy tokens as
+    the gather-oracle engine decoding the same requests sequentially."""
+    import time
+
+    lm = _small_lm()
+    prompts = [rng.randint(0, VOCAB, rng.randint(1, 12)).tolist()
+               for _ in range(8)]
+    lens = [int(rng.randint(2, 10)) for _ in prompts]
+
+    def gather_sequential():
+        eng = _engine(lm)
+        try:
+            return [eng.generate(p, n).tolist()
+                    for p, n in zip(prompts, lens)]
+        finally:
+            eng.stop()
+
+    ref = _in_mode("gather", gather_sequential)
+
+    eng = _engine(lm)            # fused default, concurrent + staggered
+    try:
+        handles = []
+        for i, (p, n) in enumerate(zip(prompts, lens)):
+            handles.append(eng.submit(p, n))
+            if i % 3 == 0:
+                time.sleep(0.002)
+        mixed = [h.result(timeout=60) for h in handles]
+    finally:
+        eng.stop()
+    assert mixed == ref
+
+
+def test_engine_prefix_cache_hit_parity(rng):
+    """A persistent prefix-cache hit restores cached KV pages the fused
+    kernel then attends over — the suffix decoded off restored pages
+    must match the gather oracle's."""
+    lm = _small_lm()
+    prefix = rng.randint(0, VOCAB, 12).tolist()
+    tails = [rng.randint(0, VOCAB, 3).tolist() for _ in range(2)]
+
+    def run():
+        eng = _engine(lm, prefix_cache=True)
+        try:
+            out, shared = [], []
+            for tail in tails:
+                h = eng.submit(prefix + tail, 6)
+                out.append(h.result(timeout=60))
+                shared.append(h.shared_len)
+            return out, shared
+        finally:
+            eng.stop()
+
+    fused_out, fused_shared = run()
+    gather_out, gather_shared = _in_mode("gather", run)
+    assert fused_shared[1] > 0 and gather_shared[1] > 0   # hit path ran
+    assert fused_out == gather_out
+
+
+def test_engine_hot_swap_parity(rng):
+    """The hot-swap drill cross-mode: greedy outputs before AND after a
+    between-requests weight swap must agree between the fused default
+    and the gather oracle."""
+    prompt = rng.randint(0, VOCAB, 6).tolist()
+
+    def run():
+        eng = _engine(_small_lm())
+        try:
+            pre = eng.generate(prompt, 8).tolist()
+            eng.deploy("default", _small_lm(seed=777))
+            post = eng.generate(prompt, 8).tolist()
+            return pre, post
+        finally:
+            eng.stop()
+
+    fused = run()
+    oracle = _in_mode("gather", run)
+    assert fused == oracle
+    assert fused[0] != fused[1]       # the swap actually changed weights
+
+
+# ------------------------------------------------------- fused epilogue
+def _np_ref(h, res, gamma, beta, eps, mask, keep):
+    x = np.asarray(h, np.float64)
+    if res is not None:
+        x = x + np.asarray(res, np.float64)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    y = ((x - mu) / np.sqrt(var + eps) * np.asarray(gamma, np.float64)
+         + np.asarray(beta, np.float64))
+    if mask is not None:
+        y = np.where(np.asarray(mask), y / keep, 0.0)
+    return y
+
+
+@pytest.mark.parametrize("variant",
+                         ["residual_dropout", "prologue", "norm_only"])
+def test_epilogue_forward_parity(variant):
+    rng = np.random.default_rng(21)
+    m, c = 17, 40                                   # pad-heavy odd shape
+    h = jnp.asarray(rng.standard_normal((m, c)), jnp.float32)
+    gamma = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    beta = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    res = (jnp.asarray(rng.standard_normal((m, c)), jnp.float32)
+           if variant == "residual_dropout" else None)
+    mask, keep, rate = None, 1.0, 0.0
+    if variant != "norm_only":
+        keep, rate = 0.75, 0.25
+        mask = jnp.asarray(rng.random((m, c)) < keep)
+    out = dropout_residual_norm(h, res, gamma, beta, eps=1e-5, rate=rate,
+                                mask=mask)
+    ref = _np_ref(h, res, gamma, beta, 1e-5,
+                  np.asarray(mask) if mask is not None else None, keep)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_epilogue_grads_match_reference():
+    rng = np.random.default_rng(22)
+    m, c = 12, 96
+    h = jnp.asarray(rng.standard_normal((m, c)), jnp.float32)
+    res = jnp.asarray(rng.standard_normal((m, c)), jnp.float32)
+    gamma = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    beta = jnp.asarray(rng.standard_normal(c), jnp.float32)
+    mask = jnp.asarray(rng.random((m, c)) < 0.8)
+
+    def fused(h, res, gamma, beta):
+        return jnp.sum(jnp.sin(dropout_residual_norm(
+            h, res, gamma, beta, eps=1e-5, rate=0.2, mask=mask)))
+
+    def ref(h, res, gamma, beta):
+        x = h + res
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + 1e-5) * gamma + beta
+        y = jnp.where(mask, y / 0.8, 0.0)
+        return jnp.sum(jnp.sin(y))
+
+    gf = jax.grad(fused, argnums=(0, 1, 2, 3))(h, res, gamma, beta)
+    gr = jax.grad(ref, argnums=(0, 1, 2, 3))(h, res, gamma, beta)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_epilogue_mask_bit_identical_to_maybe_dropout():
+    """Same rng key => the fused prologue's keep/drop pattern is the
+    SAME bernoulli draw ``Layer.maybe_dropout`` makes — the fused and
+    unfused train paths see identical masks, not just same-rate ones."""
+    from deeplearning4j_tpu.nn.layers.dense import DenseLayer
+
+    rng_key = jax.random.PRNGKey(99)
+    x = jax.random.normal(jax.random.PRNGKey(5), (9, 64), jnp.float32)
+    gamma, beta = jnp.ones((64,)), jnp.zeros((64,))
+    out = dropout_residual_norm(x, None, gamma, beta, eps=1e-5, rate=0.4,
+                                rng=rng_key, train=True)
+    layer = DenseLayer(n_in=64, n_out=64, dropout=0.4)
+    mu = jnp.mean(x, -1, keepdims=True)
+    var = jnp.var(x, -1, keepdims=True)
+    ln = (x - mu) * jax.lax.rsqrt(var + 1e-5)
+    ref = layer.maybe_dropout(ln, train=True, rng=rng_key)
+    assert bool(jnp.array_equal(out == 0.0, ref == 0.0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_epilogue_supports_gating():
+    h = FusedEpilogueHelper()                       # allow_interpret off
+    x = jnp.zeros((8, 64), jnp.float32)
+    assert not h.supports(x)                        # CPU: stock jnp path
+    h = FusedEpilogueHelper(allow_interpret=True)
+    assert h.supports(x)
+    assert not h.supports(jnp.zeros((8, 64), jnp.float64))
+    assert not h.supports(jnp.zeros((9000, 1000), jnp.float32))
+
+
+def test_residual_block_fused_parity_and_remat_grads():
+    """ResidualBlock routes its leading LayerNorm + the next sublayer's
+    input dropout through the fused prologue when the helper qualifies;
+    fused and stock paths must agree forward (train + eval) and through
+    remat gradients."""
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.composite import ResidualBlock
+    from deeplearning4j_tpu.nn.layers.dense import DenseLayer
+    from deeplearning4j_tpu.nn.layers.normalization import LayerNorm
+
+    blk = ResidualBlock(layers=(
+        LayerNorm(), DenseLayer(n_out=64, activation="relu", dropout=0.3),
+        DenseLayer(n_out=64)), remat=True).setup(InputType.feed_forward(64))
+    params = blk.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 64), jnp.float32)
+    rng_key = jax.random.PRNGKey(2)
+
+    def run(train):
+        y, _ = blk.apply(params, {}, x, train=train,
+                         rng=rng_key if train else None)
+        return y
+
+    def grads():
+        def loss(p):
+            y, _ = blk.apply(p, {}, x, train=True, rng=rng_key)
+            return jnp.sum(y * y)
+        return jax.grad(loss)(params)
+
+    ref_train, ref_eval, ref_g = run(True), run(False), grads()
+    saved = helpers._registry.get("epilogue")
+    helpers._registry["epilogue"] = FusedEpilogueHelper(
+        allow_interpret=True)
+    try:
+        fused_train, fused_eval, fused_g = run(True), run(False), grads()
+    finally:
+        if saved is None:
+            helpers._registry.pop("epilogue", None)
+        else:
+            helpers._registry["epilogue"] = saved
+    np.testing.assert_allclose(np.asarray(fused_train),
+                               np.asarray(ref_train), rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(fused_eval),
+                               np.asarray(ref_eval), rtol=2e-5, atol=2e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(fused_g),
+                    jax.tree_util.tree_leaves(ref_g)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------- harness gates
+def test_trust_registry_gate_green_on_committed_doc():
+    from deeplearning4j_tpu.observability.kerneldiff import check_registry
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "kernel_trust.json")
+    assert check_registry(path) == 0
+
+
+def test_trust_registry_gate_flags_mismatch(tmp_path):
+    import json
+
+    doc = {"kernels": {"flash_attention": {}, "ghost_kernel": {}}}
+    p = tmp_path / "trust.json"
+    p.write_text(json.dumps(doc))
+    from deeplearning4j_tpu.observability.kerneldiff import check_registry
+
+    assert check_registry(str(p)) == 1
