@@ -2223,6 +2223,337 @@ def bench_fleet(platform, peak):
     }
 
 
+def bench_fleet_serving(platform, peak):
+    """Serving-fleet control plane (fleet/, ISSUE 20) on record.
+
+    Four PACED subprocess replicas (``decode_step_floor_s`` sleeps each
+    decode step to a per-step floor — the host-waits-on-device shape, so
+    N processes on one CPU core scale like N accelerators would) behind
+    one ``FleetRouter`` fed by the PR-18 aggregator over the HTTP
+    broker.  Arms:
+
+    * **scaling** — aggregate decode tokens/sec + p99 TTFT with 1, 2,
+      and 4 live replicas (admin drain picks the arm) under 16
+      closed-loop clients; the 4-replica aggregate must hold >= 3x the
+      single replica (``scaling.scaling_4x_ok``).
+    * **affinity vs random** — same workload placed by prefix-cache
+      affinity vs the seeded-random control policy; the fleet-wide
+      radix hit rate (server-side hits/misses deltas) must be higher
+      under affinity (``affinity.affinity_beats_random``).
+    * **failover** — SIGKILL one replica with requests pinned to it:
+      queued requests must retry on survivors with ZERO client-visible
+      errors; recovery = kill -> first post-kill completion; the
+      restarted process must rejoin the routing table (fresh epoch).
+    * **rollout** — in-process fleet (deploys need the model object):
+      a clean candidate walks canary -> wave -> commit to ``promoted``;
+      a forced watch regression must roll back EVERY deployed replica
+      (``rollout.rolled_back_all``) and restore the active versions.
+
+    Steady-state traffic across the scaling+affinity arms must trigger
+    zero XLA compiles on every replica (captured from each replica's
+    /metrics BEFORE the kill drill — a restart legitimately recompiles).
+    """
+    import random as _random
+    import signal as _signal
+    import threading
+
+    from deeplearning4j_tpu.fleet import (
+        FleetRollout, FleetRouter, InProcessReplica, ReplicaSupervisor,
+    )
+    from deeplearning4j_tpu.generation.engine import GenerationEngine
+    from deeplearning4j_tpu.models.zoo import transformer_char_lm
+    from deeplearning4j_tpu.observability.fleet import FleetAggregator
+    from deeplearning4j_tpu.observability.metrics import MetricsRegistry
+    from deeplearning4j_tpu.streaming import MessageBroker
+
+    vocab, page, step_floor_ms = 64, 4, 25.0
+    clients, max_new, arm_s = 16, 8, 6.0
+    n_sessions, prefix_pages = 12, 4
+
+    def make_sessions(rng):
+        out = []
+        for i in range(n_sessions):
+            prefix = [rng.randrange(vocab)
+                      for _ in range(prefix_pages * page)]
+            out.append((f"s{i}", prefix))
+        return out
+
+    def drive(router, sessions, *, duration_s, seed):
+        """16 closed-loop clients; returns (tokens/sec, ttfts, errors)."""
+        stop_at = time.monotonic() + duration_s
+        lock = threading.Lock()
+        totals = {"tokens": 0, "errors": 0}
+        ttfts = []
+
+        def worker(k):
+            rng = _random.Random(f"{seed}:{k}")
+            while time.monotonic() < stop_at:
+                _sid, prefix = sessions[rng.randrange(len(sessions))]
+                prompt = prefix + [rng.randrange(vocab) for _ in range(3)]
+                t0 = time.perf_counter()
+                first = None
+                toks = 0
+                try:
+                    req = router.submit(prompt, max_new)
+                    for _ in req.stream(timeout=60):
+                        if first is None:
+                            first = time.perf_counter() - t0
+                        toks += 1
+                except Exception:
+                    with lock:
+                        totals["errors"] += 1
+                    continue
+                with lock:
+                    totals["tokens"] += toks
+                    if first is not None:
+                        ttfts.append(first)
+
+        t_start = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(k,), daemon=True)
+                   for k in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t_start
+        return totals["tokens"] / elapsed, ttfts, totals["errors"]
+
+    def compiles_of(handle):
+        total = 0.0
+        for line in handle.metrics_text().splitlines():
+            if line.startswith("dl4j_compiles_total"):
+                total += float(line.rsplit(None, 1)[-1])
+        return total
+
+    def cache_counts(handles):
+        hits = misses = 0
+        for h in handles.values():
+            st = h.cache_stats().get("prefix_cache") or {}
+            hits += int(st.get("hits") or 0)
+            misses += int(st.get("misses") or 0)
+        return hits, misses
+
+    rng = _random.Random(20)
+    workers = [f"w{i}" for i in range(4)]
+    broker = MessageBroker()
+    burl = f"http://127.0.0.1:{broker.serve(port=0)}"
+    agg = FleetAggregator(url=burl, expire_after_s=3.0,
+                          registry=MetricsRegistry()).start()
+    sup = ReplicaSupervisor(
+        broker_url=burl, warmup_timeout_s=240,
+        registry=MetricsRegistry(),
+        replica_args={"slots": 4, "page_size": page, "max_context": 48,
+                      "prefill_buckets": "24", "vocab": vocab,
+                      "d_model": 32, "n_heads": 2, "layers": 1,
+                      "interval_s": 0.25, "max_queue": 64,
+                      "step_floor_ms": step_floor_ms}).start()
+    router = FleetRouter(aggregator=agg, page_size=page, seed=20,
+                         refresh_interval_s=0.1,
+                         registry=MetricsRegistry())
+    scaling = {}
+    try:
+        # spawn all four first, THEN take the warmup barrier: the AOT
+        # warmups time-share the core either way, but total wall time
+        # stays one warmup span instead of four
+        t_spawn0 = time.perf_counter()
+        for wid in workers:
+            sup.start_replica(wid, wait_ready=False)
+        for rp in sup.processes().values():
+            sup._wait_ready(rp)
+        spawn_s = time.perf_counter() - t_spawn0
+        handles = sup.handles()
+        for wid in workers:
+            router.attach(handles[wid])
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sum(r["live"] for r in router.replicas()) == 4:
+                break
+            time.sleep(0.1)
+        live = sum(r["live"] for r in router.replicas())
+        if live != 4:
+            raise RuntimeError(f"only {live}/4 replicas went live")
+
+        # settle traffic, then pin the compile baseline
+        drive(router, make_sessions(rng), duration_s=1.5, seed=0)
+        compiles_before = {wid: compiles_of(handles[wid])
+                           for wid in workers}
+
+        # ---- scaling arms: 1 / 2 / 4 live replicas -------------------
+        for n_live in (1, 2, 4):
+            for i, wid in enumerate(workers):
+                router.drain(wid, i >= n_live)
+            sessions = make_sessions(rng)   # cold sessions per arm
+            tps, ttfts, errors = drive(router, sessions,
+                                       duration_s=arm_s, seed=n_live)
+            scaling[str(n_live)] = {
+                "tokens_per_sec": round(tps, 1),
+                "p99_ttft_ms": round(
+                    float(np.percentile(ttfts, 99)) * 1e3, 1),
+                "requests": len(ttfts),
+                "errors": errors,
+            }
+        for wid in workers:
+            router.drain(wid, False)
+        speedup = (scaling["4"]["tokens_per_sec"]
+                   / scaling["1"]["tokens_per_sec"])
+        scaling["speedup_4x_vs_1"] = round(speedup, 2)
+        scaling["scaling_4x_ok"] = int(speedup >= 3.0)
+
+        # ---- affinity vs seeded-random placement ---------------------
+        affinity = {}
+        for policy in ("random", "affinity"):
+            router.policy = policy
+            h0, m0 = cache_counts(handles)
+            tps, _ttfts, _errors = drive(router, make_sessions(rng),
+                                         duration_s=arm_s, seed=99)
+            h1, m1 = cache_counts(handles)
+            lookups = (h1 - h0) + (m1 - m0)
+            affinity[policy] = {
+                "tokens_per_sec": round(tps, 1),
+                "hit_rate": round((h1 - h0) / lookups, 4) if lookups
+                else 0.0,
+            }
+        router.policy = "affinity"
+        affinity["affinity_beats_random"] = int(
+            affinity["affinity"]["hit_rate"]
+            > affinity["random"]["hit_rate"])
+
+        # steady-state compile contract — captured BEFORE the kill drill
+        # (the restarted process legitimately re-runs its AOT warmup)
+        per_replica_compiles = {
+            wid: compiles_of(handles[wid]) - compiles_before[wid]
+            for wid in workers}
+        steady_compiles = max(per_replica_compiles.values())
+
+        # ---- failover drill: SIGKILL with pinned traffic -------------
+        drill_prefix = [rng.randrange(vocab) for _ in range(16)]
+        victim = router.pin_session("drill", drill_prefix)
+        survivors = [w for w in workers if w != victim]
+        t_kill = time.perf_counter()
+        sup.kill(victim, sig=_signal.SIGKILL, restart=True)
+        recovery_ms = None
+        ok = errors = 0
+        for _ in range(8):
+            try:
+                req = router.submit(drill_prefix, 2, session_id="drill")
+                req.result(timeout=60)
+                ok += 1
+                if recovery_ms is None:
+                    recovery_ms = (time.perf_counter() - t_kill) * 1e3
+            except Exception:
+                errors += 1
+        repinned = router.session_replica("drill") in survivors
+        rejoin_deadline = time.monotonic() + 90
+        rejoined = False
+        while time.monotonic() < rejoin_deadline:
+            rows = {r["replica"]: r for r in router.replicas()}
+            if rows.get(victim, {}).get("live"):
+                rejoined = True
+                break
+            time.sleep(0.2)
+        failover = {
+            "victim": victim,
+            "requests_after_kill": ok + errors,
+            "queued_errors": errors,
+            "zero_queued_errors": int(errors == 0),
+            "recovery_ms": (round(recovery_ms, 1)
+                            if recovery_ms is not None else None),
+            "session_repinned": int(bool(repinned)),
+            "restart_rejoined": int(rejoined),
+        }
+    finally:
+        sup.stop_all()
+        agg.stop()
+        broker.stop()
+
+    # ---- fleet rollout drill (in-process: deploys need the model) ----
+    def small_engine():
+        lm = transformer_char_lm(vocab_size=40, d_model=32, n_heads=2,
+                                 layers=1, max_cache=32)
+        return GenerationEngine(lm, slots=2, page_size=4, max_context=32,
+                                prefill_buckets=(8,),
+                                prefix_cache=True).start()
+
+    engines = {"r0": small_engine(), "r1": small_engine()}
+    ro_router = FleetRouter(page_size=4, seed=7,
+                            registry=MetricsRegistry())
+    ro_handles = {rid: InProcessReplica(rid, e)
+                  for rid, e in engines.items()}
+    for h in ro_handles.values():
+        ro_router.attach(h)
+    stop_load = threading.Event()
+
+    def load():
+        while not stop_load.is_set():
+            try:
+                ro_router.submit([1] * 8, 2).result(timeout=30)
+            except Exception:
+                time.sleep(0.05)
+
+    loader = threading.Thread(target=load, daemon=True)
+    loader.start()
+    try:
+        def candidate(seed):
+            return transformer_char_lm(vocab_size=40, d_model=32,
+                                       n_heads=2, layers=1, max_cache=32,
+                                       seed=seed)
+
+        ro_kw = dict(canary_fraction=0.5, canary_min_requests=2,
+                     canary_timeout_s=60, watch_window_s=0.3,
+                     watch_poll_s=0.05, registry=ro_router.registry)
+        good = FleetRollout(ro_router, ro_handles, **ro_kw).consider(
+            candidate(777), "good")
+        after_good = {rid: e.models.active("default").version
+                      for rid, e in engines.items()}
+        bad = FleetRollout(
+            ro_router, ro_handles,
+            watch_extra_fn=lambda rid: {"probe_ok": False,
+                                        "probe_detail": "forced"},
+            **ro_kw).consider(candidate(778), "bad")
+        restored = {rid: e.models.active("default").version
+                    for rid, e in engines.items()}
+        rollout = {
+            "good_outcome": good.outcome,
+            "promoted": int(good.outcome == "promoted"
+                            and sorted(good.committed) == sorted(engines)),
+            "forced_outcome": bad.outcome,
+            "rolled_back_all": int(
+                bad.outcome == "rolled_back"
+                and sorted(bad.rolled_back) == sorted(engines)),
+            "versions_restored": int(restored == after_good),
+        }
+    finally:
+        stop_load.set()
+        loader.join(timeout=5)
+        for e in engines.values():
+            e.stop(drain=False)
+
+    return {
+        "metric": (f"Fleet serving tokens/sec (4 paced subprocess "
+                   f"replicas, step floor {step_floor_ms:g} ms, "
+                   f"{clients} clients)"),
+        "value": scaling["4"]["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "vs_baseline": None,   # no reference analog (single-host DL4J)
+        "data": "synthetic",
+        "dtype": "float32",
+        "paced": {
+            "step_floor_ms": step_floor_ms,
+            "note": ("decode steps sleep to a per-step floor "
+                     "(host-waits-on-device sim) so multi-process "
+                     "scaling on one CPU core is honest"),
+        },
+        "spawn_warmup_s": round(spawn_s, 2),
+        "p99_ttft_ms": scaling["4"]["p99_ttft_ms"],
+        "scaling": scaling,
+        "affinity": affinity,
+        "failover": failover,
+        "rollout": rollout,
+        "steady_state_compiles": steady_compiles,
+        "per_replica_compiles": per_replica_compiles,
+    }
+
+
 def _performance_attribution(metrics, dev):
     """The observability.performance section: step FLOPs, MFU (spec-sheet
     peak on TPU, documented CPU estimate otherwise — always labeled), and
@@ -2287,7 +2618,8 @@ def main():
             ("stability", lambda: bench_stability(platform, peak)),
             ("introspection", lambda: bench_introspection(platform, peak)),
             ("numerics", lambda: bench_numerics(platform, peak)),
-            ("fleet", lambda: bench_fleet(platform, peak))):
+            ("fleet", lambda: bench_fleet(platform, peak)),
+            ("fleet_serving", lambda: bench_fleet_serving(platform, peak))):
         try:
             with phases.phase(name):
                 metrics.append(fn())

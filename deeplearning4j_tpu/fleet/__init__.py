@@ -1,0 +1,35 @@
+"""Serving-fleet control plane: router, replicas, supervisor, rollout.
+
+Lazy re-exports (PEP 562) so importing one corner does not pay for the
+rest — ``fleet.placement`` in particular stays stdlib-only for the CI
+placement-policy gate.
+"""
+
+_EXPORTS = {
+    "ShadowIndex": "placement",
+    "ReplicaView": "placement",
+    "placement_selftest": "placement",
+    "FleetRouter": "router",
+    "FleetRequest": "router",
+    "NoLiveReplicaError": "router",
+    "InProcessReplica": "replica",
+    "HTTPReplica": "replica",
+    "ReplicaError": "replica",
+    "ReplicaSupervisor": "supervisor",
+    "ReplicaProcess": "supervisor",
+    "free_port": "supervisor",
+    "FleetRollout": "rollout",
+    "FleetRolloutResult": "rollout",
+    "FleetFrontend": "frontend",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)

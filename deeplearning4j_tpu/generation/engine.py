@@ -77,7 +77,8 @@ class GenerationEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  models: Optional[ModelRegistry] = None, registry=None,
                  default_model: str = DEFAULT_MODEL,
-                 prefix_cache=None, slo_targets: Optional[dict] = None):
+                 prefix_cache=None, slo_targets: Optional[dict] = None,
+                 decode_step_floor_s: float = 0.0):
         if max_context < 2:
             raise ValueError(f"max_context={max_context} must be >= 2")
         pages_per_slot = -(-int(max_context) // int(page_size))
@@ -130,6 +131,14 @@ class GenerationEngine:
         self._drain = True
         self._thread: Optional[threading.Thread] = None
         self.steady_deliveries = 0      # tokens delivered since start
+        # device-simulation pacing: enforce a minimum wall time per
+        # decode step.  On real accelerators the host thread mostly
+        # WAITS on the device, so N replica processes scale across N
+        # chips even on one host core; on the CPU tier the "device" IS
+        # the host core and replicas contend instead.  The fleet bench
+        # sets this to model the device-bound regime honestly (labeled
+        # "paced" in its output); 0 disables and changes nothing.
+        self.decode_step_floor_s = float(decode_step_floor_s)
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> "GenerationEngine":
@@ -454,6 +463,7 @@ class GenerationEngine:
     def _step(self, progs: GenerationPrograms, mv: ModelVersion) -> None:
         s = self.scheduler
         active = len(s.active_slots())
+        t_step0 = time.perf_counter()
         with step_guard("decode_step", engine=self.metrics.engine_id,
                         active=active):
             with self.phases.phase("jitted_step"):
@@ -470,6 +480,13 @@ class GenerationEngine:
             self.metrics.tokens.inc(delivered, model=mv.name)
             self.metrics.batch_occupancy.observe(active / s.num_slots)
             self._refresh_gauges()
+        if self.decode_step_floor_s > 0.0:
+            # sleep (not spin) to the floor: the yielded core is exactly
+            # what lets sibling replica processes decode concurrently
+            remain = self.decode_step_floor_s - (time.perf_counter()
+                                                 - t_step0)
+            if remain > 0:
+                time.sleep(remain)
 
     def _refresh_gauges(self) -> None:
         self.metrics.active_slots.set(len(self.scheduler.active_slots()))

@@ -269,7 +269,7 @@ class TelemetryPublisher:
                  prefix_cache=None,
                  slo: Optional[SLOTracker] = None,
                  max_samples_per_family: int = 64,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0, retry_policy=None):
         if broker is not None and url is not None:
             raise ValueError("pass broker= or url=, not both")
         self.worker_id = str(worker_id)
@@ -296,6 +296,19 @@ class TelemetryPublisher:
         self._m_bytes = reg.gauge(
             _BYTES, "Serialized size of the most recently published "
             "telemetry snapshot")
+        # publish-loop hygiene (PR-5 RetryPolicy): a transient broker /
+        # aggregator outage backs off and resumes instead of warning
+        # every period — load-bearing now that the fleet router reads
+        # snapshot liveness as a membership signal.  Backoff sleeps go
+        # through the stop event so stop() never waits out a retry.
+        if retry_policy is None:
+            from deeplearning4j_tpu.resilience.retry import RetryPolicy
+            retry_policy = RetryPolicy(
+                max_retries=3, base_delay_s=min(0.25, self.interval_s),
+                max_delay_s=max(2.0, self.interval_s),
+                component="telemetry", registry=reg,
+                sleep=self._stop.wait)
+        self.retry_policy = retry_policy
 
     # ------------------------------------------------------------ snapshot
     def _prefix_cache_stats(self) -> Optional[Dict[str, Any]]:
@@ -390,6 +403,24 @@ class TelemetryPublisher:
         null by the snapshot walk, so the strict encoder never trips)."""
         return json.dumps(self.snapshot(), sort_keys=True, allow_nan=False)
 
+    def _send(self, payload: str) -> int:
+        """Raw transport send; raises on failure."""
+        if self.broker is not None:
+            return self.broker.publish(self.topic, payload)
+        if self.url is not None:
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"{self.url}/publish/{self.topic}",
+                data=payload.encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return int(json.loads(resp.read().decode()
+                                      or '{"delivered": 0}')
+                           .get("delivered", 0))
+        return 0
+
     def publish_once(self) -> int:
         """Serialize + publish one snapshot; delivered-subscriber count
         (HTTP: the broker's count), -1 on any failure — the decode/train
@@ -402,26 +433,34 @@ class TelemetryPublisher:
             return -1
         self._m_bytes.set(float(len(payload)))
         try:
-            if self.broker is not None:
-                n = self.broker.publish(self.topic, payload)
-            elif self.url is not None:
-                import urllib.request
-
-                req = urllib.request.Request(
-                    f"{self.url}/publish/{self.topic}",
-                    data=payload.encode(),
-                    headers={"Content-Type": "application/json"})
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout) as resp:
-                    n = int(json.loads(resp.read().decode()
-                                       or '{"delivered": 0}')
-                            .get("delivered", 0))
-            else:
-                n = 0
-            return n
+            return self._send(payload)
         except Exception as e:
             self._warn("publish", f"telemetry publish failed: {e!r}")
             return -1
+        finally:
+            self._m_publish.observe(time.perf_counter() - t0)
+
+    def _publish_strict(self) -> int:
+        """``publish_once`` minus the swallow, for the retrying publish
+        loop: serialization failures raise AS-IS (a snapshot that cannot
+        serialize is a deterministic bug — fatal to the RetryPolicy, so
+        it surfaces instead of backing off), transport outages raise
+        ``TransientError`` (including broker-side 5xx, which the message
+        classification alone would call fatal)."""
+        from deeplearning4j_tpu.resilience.retry import (
+            TransientError, is_transient)
+
+        t0 = time.perf_counter()
+        payload = self.serialize()
+        self._m_bytes.set(float(len(payload)))
+        try:
+            return self._send(payload)
+        except Exception as e:
+            code = getattr(e, "code", None)
+            if is_transient(e) or (isinstance(code, int) and code >= 500):
+                raise TransientError(
+                    f"telemetry publish failed: {e!r}") from e
+            raise
         finally:
             self._m_publish.observe(time.perf_counter() - t0)
 
@@ -436,9 +475,25 @@ class TelemetryPublisher:
         return self
 
     def _run(self) -> None:
-        self.publish_once()  # first snapshot immediately, not after a wait
-        while not self._stop.wait(self.interval_s):
-            self.publish_once()
+        # first snapshot immediately, not after a wait; every period then
+        # rides the RetryPolicy — transient outages back off (stop-event
+        # interruptible) and resume, anything past the retry budget (or
+        # fatal outright) surfaces once per warn interval and the loop
+        # carries on at the next period
+        first = True
+        while True:
+            if not first and self._stop.wait(self.interval_s):
+                return
+            first = False
+            if self._stop.is_set():
+                return
+            try:
+                self.retry_policy.run(self._publish_strict,
+                                      description="telemetry publish",
+                                      context={"worker": self.worker_id})
+            except Exception as e:
+                self._warn("publish",
+                           f"telemetry publish failed after retries: {e!r}")
 
     def stop(self) -> None:
         if self._thread is None:

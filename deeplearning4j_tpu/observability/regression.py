@@ -209,6 +209,41 @@ DEFAULT_RULES: List[Rule] = [
     Rule("Fleet telemetry ingest lag",
          field="federation.restart_merge_ok",
          tolerance=0.0, required=False),
+    # serving fleet (bench_fleet_serving, ISSUE 20): the 4-replica
+    # aggregate is the headline; scaling_4x_ok pins the >=3.0x floor of
+    # the 4-vs-1 aggregate (1 = floor held; direction=higher +
+    # tolerance=0 means any drop to 0 regresses) with the raw speedup
+    # tracked alongside; affinity_beats_random pins "cache-aware
+    # placement finds more resident prefixes than the seeded-random
+    # control"; zero_queued_errors pins the failover contract (a
+    # SIGKILLed replica's queued requests land on survivors with no
+    # client-visible error) and rejoin/rollback verdicts pin the
+    # lifecycle halves; the exact-zero compile rule pins steady-state
+    # traffic across the scaling+affinity arms (captured before the
+    # kill drill — a restart legitimately re-runs its AOT warmup)
+    Rule("Fleet serving tokens/sec", tolerance=0.4),
+    Rule("Fleet serving tokens/sec", field="scaling.speedup_4x_vs_1",
+         tolerance=0.4, required=False),
+    Rule("Fleet serving tokens/sec", field="scaling.scaling_4x_ok",
+         tolerance=0.0, required=False),
+    Rule("Fleet serving tokens/sec", field="p99_ttft_ms",
+         direction=LOWER, tolerance=1.0, required=False),
+    Rule("Fleet serving tokens/sec",
+         field="affinity.affinity_beats_random",
+         tolerance=0.0, required=False),
+    Rule("Fleet serving tokens/sec",
+         field="failover.zero_queued_errors",
+         tolerance=0.0, required=False),
+    Rule("Fleet serving tokens/sec", field="failover.recovery_ms",
+         direction=LOWER, tolerance=3.0, required=False),
+    Rule("Fleet serving tokens/sec", field="failover.restart_rejoined",
+         tolerance=0.0, required=False),
+    Rule("Fleet serving tokens/sec", field="rollout.promoted",
+         tolerance=0.0, required=False),
+    Rule("Fleet serving tokens/sec", field="rollout.rolled_back_all",
+         tolerance=0.0, required=False),
+    Rule("Fleet serving tokens/sec", field="steady_state_compiles",
+         direction=LOWER, tolerance=0.0, required=False),
     # memory & collective-communication sentinels (bench _memory_measure
     # -> observability.memory.sentinels): FLIPPED to the ZeRO baselines
     # by the update-sharding PR (ROADMAP item 2, arXiv 2004.13336) — the

@@ -98,7 +98,7 @@ class InferenceServer:
                  example: Optional[np.ndarray] = None,
                  engine: Optional[ServingEngine] = None,
                  health_rules=None, access_log: bool = False,
-                 generation=None):
+                 generation=None, replica_id: Optional[str] = None):
         if engine is None:
             if model is None:
                 raise ValueError("InferenceServer needs a model or an engine")
@@ -121,6 +121,11 @@ class InferenceServer:
         # lifecycle (start/stop, deploys) belongs to its owner — the
         # server only routes, exactly like a shared predict engine
         self.generation = generation
+        # fleet identity: when set (subprocess replicas behind the fleet
+        # router), every /generate envelope, SSE terminal event, and
+        # access-log line names the replica that served it — the "which
+        # replica did this come from" half of the routing trace
+        self.replica_id = replica_id
         self.model = model
         self.max_batch = engine.policy.max_batch
         self.max_wait_ms = engine.batcher.max_wait_s * 1000.0
@@ -186,6 +191,7 @@ class InferenceServer:
             access_logger.info(json.dumps({
                 "trace_id": trace_id,
                 "endpoint": "generate",
+                "replica": self.replica_id,
                 "status": status,
                 "http_status": http_status,
                 "tokens": len(req.tokens) if req is not None else None,
@@ -276,6 +282,9 @@ class InferenceServer:
                         self._predict()
                     elif self.path == "/generate":
                         self._generate()
+                    elif self.path in ("/generation/pin",
+                                       "/generation/unpin"):
+                        self._pin(self.path.endswith("/unpin"))
                     elif (self.path.startswith("/models/")
                           and self.path.endswith("/rollback")):
                         self._rollback(
@@ -389,7 +398,39 @@ class InferenceServer:
                             "finish_reason": req.finish_reason,
                             "ttft_ms": (round(req.ttft_s * 1e3, 3)
                                         if req.ttft_s is not None else None),
-                            "trace_id": tid})
+                            "trace_id": tid,
+                            "replica": server.replica_id})
+
+            def _pin(self, unpin):
+                """POST /generation/pin {"prompt": [ids]} -> {"pin_id"}
+                and /generation/unpin {"pin_id"} — the HTTP face of
+                ``pin_prefix``/``unpin_prefix``, so the fleet router can
+                pin sticky sessions on subprocess replicas."""
+                gen = server.generation
+                if gen is None or getattr(gen, "prefix_cache", None) is None:
+                    raise _BadRequest(
+                        "this server has no prefix-cache-enabled "
+                        "generation engine")
+                obj = self._read_json()
+                if unpin:
+                    if not isinstance(obj, dict) or "pin_id" not in obj:
+                        raise _BadRequest('unpin body must be {"pin_id": n}')
+                    try:
+                        gen.unpin_prefix(int(obj["pin_id"]))
+                    except KeyError as e:
+                        raise _BadRequest(f"unknown pin: {e}")
+                    self._json({"ok": True,
+                                "replica": server.replica_id})
+                    return
+                if not isinstance(obj, dict) or "prompt" not in obj:
+                    raise _BadRequest(
+                        'pin body must be {"prompt": [token ids]}')
+                try:
+                    pin_id = gen.pin_prefix([int(t) for t in obj["prompt"]])
+                except (TypeError, ValueError) as e:
+                    raise _BadRequest(f"bad pin request: {e}")
+                self._json({"pin_id": pin_id,
+                            "replica": server.replica_id})
 
             def _stream_tokens(self, gen, req, tid):
                 """Server-Sent Events: one event per token as the decode
@@ -415,11 +456,13 @@ class InferenceServer:
                            "finish_reason": req.finish_reason,
                            "ttft_ms": (round(req.ttft_s * 1e3, 3)
                                        if req.ttft_s is not None else None),
-                           "trace_id": tid})
+                           "trace_id": tid,
+                           "replica": server.replica_id})
                 except ServingError as e:
                     status, code = type(e).__name__, e.http_status
                     event({"error": str(e), "type": status,
-                           "trace_id": tid, "done": True})
+                           "trace_id": tid, "done": True,
+                           "replica": server.replica_id})
                 except BrokenPipeError:
                     # client went away: stop wasting decode slots on it
                     req.cancel()

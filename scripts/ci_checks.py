@@ -18,11 +18,18 @@ Runs, in order:
    the kerneldiff sweep registry must list the same kernels in both
    directions, so no fused kernel can merge without sweep evidence and
    no stale trust entry can outlive its kernel
-   (``kerneldiff --check-registry``).
+   (``kerneldiff --check-registry``);
+6. ``fleet placement self-test`` — the router's placement policy
+   simulated end to end with no jax and no package imports
+   (``fleet/placement.py`` is loaded BY FILE PATH, same pattern as the
+   bench sentinel): deterministic seeded ties, affinity beating the
+   seeded-random control on hit rate, version-tag shadow invalidation,
+   drain/stale/dead exclusion, canary-split fractions, session pins.
 
-All five run in a few seconds with no device work — this is the
+All six run in a few seconds with no device work — this is the
 pre-test gate: run it before the pytest tiers and fail fast on lint
-debt, a broken sentinel, or a fleet wire-schema drift.
+debt, a broken sentinel, a fleet wire-schema drift, or a placement
+policy regression.
 
 Usage::
 
@@ -63,6 +70,15 @@ CHECKS: List[Tuple[str, List[str]]] = [
      [sys.executable, "-m",
       "deeplearning4j_tpu.observability.kerneldiff",
       "--check-registry", os.path.join(REPO, "kernel_trust.json")]),
+    ("fleet placement self-test",
+     [sys.executable, "-c",
+      "import importlib.util, sys; "
+      "spec = importlib.util.spec_from_file_location("
+      "'fleet_placement', "
+      f"{os.path.join(REPO, 'deeplearning4j_tpu', 'fleet', 'placement.py')!r}); "
+      "m = importlib.util.module_from_spec(spec); "
+      "spec.loader.exec_module(m); "
+      "sys.exit(m.placement_selftest(verbose=True))"]),
 ]
 
 

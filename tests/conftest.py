@@ -121,6 +121,14 @@ def pytest_configure(config):
         "parity) and the fused dropout/residual/norm train epilogue "
         "(parity, grads, dropout-mask bit-identity) "
         "(python -m pytest -m kernels)")
+    config.addinivalue_line(
+        "markers",
+        "fleet_router: serving-fleet control-plane tests — cache-aware "
+        "placement (prefix affinity, seeded ties, canary split), "
+        "health-gated membership, SIGKILL failover with queued-request "
+        "retry and session re-pin, fleet-wide canary rollout with "
+        "auto-rollback, replica supervisor lifecycle "
+        "(python -m pytest -m fleet_router)")
 
 
 def pytest_collection_modifyitems(config, items):
